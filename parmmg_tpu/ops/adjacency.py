@@ -1,0 +1,121 @@
+"""Tet-tet adjacency and boundary detection, sort-based (jittable).
+
+Replaces the reference's hash-table face matching (``MMG3D_hashTetra``, used
+at e.g. /root/reference/src/libparmmg1.c:733, and the parallel edge hashes of
+hash_pmmg.c:147-234) with the TPU idiom: materialize all 4*capT faces as
+sorted vertex triples, sort them, and match equal neighbors in sorted order.
+Sorting is XLA-friendly (static shapes, no data-dependent control flow); a
+hash table with chaining is not.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.mesh import Mesh, tet_face_vertices
+from ..core.constants import MG_BDY
+
+
+def _face_keys(mesh: Mesh):
+    """Sorted-triple face keys as 3 int32 columns, invalid tets last.
+
+    Pure int32 (no int64 emulation on TPU): multi-column keys are matched
+    with ``jnp.lexsort`` + column-wise equality instead of one packed key.
+    Returns (cols [F,3], tetid [F], faceid [F]).
+    """
+    capT = mesh.capT
+    fv = tet_face_vertices(mesh.tet).reshape(capT * 4, 3)       # [F,3]
+    fv = jnp.sort(fv, axis=1)
+    invalid = ~jnp.repeat(mesh.tmask, 4)
+    big = jnp.iinfo(jnp.int32).max
+    fv = jnp.where(invalid[:, None], big, fv)
+    tetid = jnp.repeat(jnp.arange(capT, dtype=jnp.int32), 4)
+    faceid = jnp.tile(jnp.arange(4, dtype=jnp.int32), capT)
+    return fv, tetid, faceid
+
+
+def build_adjacency(mesh: Mesh) -> Mesh:
+    """Compute ``adja`` and mark unmatched faces as boundary (MG_BDY).
+
+    In a conforming mesh every interior face appears exactly twice. After
+    sorting face keys, twins are neighbors in sorted order; the pairing is
+    scattered back as ``adja[t,f] = 4*t' + f'``.
+    """
+    capT = mesh.capT
+    cols, tetid, faceid = _face_keys(mesh)
+    order = jnp.lexsort((cols[:, 2], cols[:, 1], cols[:, 0]))
+    k = cols[order]
+    t = tetid[order]
+    f = faceid[order]
+
+    eq_next = jnp.all(k[1:] == k[:-1], axis=1) & (k[:-1, 0] != jnp.iinfo(jnp.int32).max)
+    same_next = jnp.concatenate([eq_next, jnp.array([False])])
+    same_prev = jnp.concatenate([jnp.array([False]), eq_next])
+    # partner index in sorted order (self if unmatched)
+    idx = jnp.arange(capT * 4)
+    partner = jnp.where(same_next, idx + 1, jnp.where(same_prev, idx - 1, idx))
+    matched = same_next | same_prev
+    adj_val = jnp.where(matched, 4 * t[partner] + f[partner], -1)
+
+    adja = jnp.full((capT, 4), -1, jnp.int32)
+    adja = adja.at[t, f].set(adj_val.astype(jnp.int32))
+    adja = jnp.where(mesh.tmask[:, None], adja, -1)
+
+    # boundary faces: valid tet, face has no twin
+    is_bdy = (adja < 0) & mesh.tmask[:, None]
+    ftag = jnp.where(is_bdy, mesh.ftag | MG_BDY, mesh.ftag)
+    return dataclasses_replace(mesh, adja=adja, ftag=ftag)
+
+
+def dataclasses_replace(mesh: Mesh, **kw) -> Mesh:
+    import dataclasses
+    return dataclasses.replace(mesh, **kw)
+
+
+def check_adjacency(mesh: Mesh) -> dict:
+    """Invariant oracle (debug): symmetric adja, shared vertices agree.
+
+    The analogue of the reference's communicator/adjacency assertions
+    (chkcomm_pmmg.c): run off the hot path, returns violation counts.
+    """
+    adja = mesh.adja
+    nb = adja >> 2
+    nf = adja & 3
+    valid = adja >= 0
+    # symmetry: adja[nb, nf] must point back
+    back = jnp.where(valid, adja[jnp.clip(nb, 0, mesh.capT - 1), nf], -1)
+    tid = jnp.arange(mesh.capT, dtype=jnp.int32)[:, None]
+    fid = jnp.arange(4, dtype=jnp.int32)[None, :]
+    sym_bad = jnp.sum(jnp.where(valid, back != 4 * tid + fid, False))
+    # shared face must consist of the same 3 vertices
+    fv = jnp.sort(tet_face_vertices(mesh.tet), axis=2)           # [T,4,3]
+    nbv = fv[jnp.clip(nb, 0, mesh.capT - 1), nf]
+    face_bad = jnp.sum(
+        jnp.where(valid[..., None], fv != nbv, False))
+    return {"asymmetric": int(sym_bad), "face_mismatch": int(face_bad)}
+
+
+def boundary_edge_tags(mesh: Mesh) -> Mesh:
+    """Propagate MG_BDY from boundary faces to their edges and vertices."""
+    from ..core.constants import FACE_EDGES
+    fe = jnp.asarray(FACE_EDGES)                     # [4,3]
+    is_bdy_face = (mesh.ftag & MG_BDY) != 0          # [T,4]
+    # edges of boundary faces get MG_BDY
+    etag = mesh.etag
+    edge_hit = jnp.zeros((mesh.capT, 6), bool)
+    for f in range(4):
+        for j in range(3):
+            e = int(FACE_EDGES[f, j])
+            edge_hit = edge_hit.at[:, e].set(edge_hit[:, e] | is_bdy_face[:, f])
+    etag = jnp.where(edge_hit, etag | MG_BDY, etag)
+    # vertices of boundary faces get MG_BDY
+    from ..core.constants import IDIR
+    vtag = mesh.vtag
+    hit = jnp.zeros(mesh.capP, bool)
+    for f in range(4):
+        vids = mesh.tet[:, jnp.asarray(IDIR[f])]     # [T,3]
+        m = is_bdy_face[:, f] & mesh.tmask
+        hit = hit.at[vids.reshape(-1)].max(
+            jnp.repeat(m, 3))
+    vtag = jnp.where(hit, vtag | MG_BDY, vtag)
+    return dataclasses_replace(mesh, etag=etag, vtag=vtag)

@@ -1,0 +1,185 @@
+"""Batched edge collapse — data-parallel replacement for Mmg's colver.
+
+Reference behavior: short edges (metric length < 1/sqrt(2)) are removed by
+merging one endpoint into the other; the shell tets die, the rest of the
+removed vertex's ball is rewritten.  Constraints reproduced from Mmg's
+``MMG5_colver`` checks + the ParMmg freeze contract (tag_pmmg.c:39-124):
+required/corner/parallel vertices never move; boundary points only collapse
+along boundary edges onto boundary points; ridge points only along ridges.
+
+Independent-set scheduling (one wave):
+  1. candidates = short, un-frozen edges; pick a *removed* endpoint per edge;
+  2. per-vertex "top remover" priorities; geometric validity (positive
+     volumes, no boundary fold-over, no overlong new edges) is evaluated for
+     top removers only, tet-centrically;
+  3. claims: a winner must be argmax at both endpoints and on every tet of
+     the removed vertex's ball — so winner balls are disjoint and the
+     per-candidate precheck stays exact under simultaneous application;
+  4. apply via a vertex remap gather; shell tets (containing both endpoints)
+     are invalidated; face tags of dying tets transfer to the surviving
+     neighbor across (that face was interior, it becomes boundary iff it was
+     tagged).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from ..core.mesh import Mesh
+from ..core.constants import (
+    IDIR, LSHRT, LLONG, EPSD, MG_BDY, MG_CRN, MG_GEO, MG_NOM, MG_REQ,
+    MG_PARBDY, QUAL_FLOOR)
+from .edges import unique_edges, edge_lengths, unique_priority
+
+_IDIR_J = jnp.asarray(IDIR)
+
+
+class CollapseResult(NamedTuple):
+    mesh: Mesh
+    ncollapse: jax.Array
+
+
+def _removable(vtag, other_vtag, edge_tag):
+    """May vertex v (tags vtag) be deleted by collapsing along this edge?"""
+    free = (vtag & (MG_REQ | MG_CRN | MG_PARBDY | MG_NOM)) == 0
+    on_bdy = (vtag & MG_BDY) != 0
+    bdy_ok = ~on_bdy | (((edge_tag & MG_BDY) != 0) &
+                        ((other_vtag & MG_BDY) != 0))
+    on_geo = (vtag & MG_GEO) != 0
+    geo_ok = ~on_geo | (((edge_tag & MG_GEO) != 0) &
+                        ((other_vtag & MG_GEO) != 0))
+    return free & bdy_ok & geo_ok
+
+
+def collapse_wave(mesh: Mesh, met: jax.Array, lmin: float = LSHRT,
+                  lmax: float = LLONG) -> CollapseResult:
+    capT, capP = mesh.capT, mesh.capP
+    et = unique_edges(mesh)
+    lens = edge_lengths(mesh, et, met)
+    va = jnp.clip(et.ev[:, 0], 0, capP - 1)
+    vb = jnp.clip(et.ev[:, 1], 0, capP - 1)
+
+    frozen_edge = (et.etag & (MG_REQ | MG_PARBDY)) != 0
+    short = et.emask & (lens < lmin) & ~frozen_edge
+
+    ta, tb = mesh.vtag[va], mesh.vtag[vb]
+    rem_b = _removable(tb, ta, et.etag)      # can delete b (keep a)
+    rem_a = _removable(ta, tb, et.etag)
+    # prefer deleting the topologically freer endpoint; deterministic choice
+    del_b = rem_b
+    rm = jnp.where(del_b, vb, va)
+    kp = jnp.where(del_b, va, vb)
+    cand = short & (rem_a | rem_b)
+
+    pri = unique_priority(-lens, cand)                     # short = high
+    # per-vertex top remover and its kept endpoint
+    rmpri = jnp.zeros(capP, jnp.int32).at[rm].max(jnp.where(cand, pri, 0))
+    is_top = cand & (pri == rmpri[rm]) & (pri > 0)
+    kept_of = jnp.zeros(capP, jnp.int32).at[
+        jnp.where(is_top, rm, capP)].set(kp, mode="drop")
+
+    # --- geometric validity of top removers, tet-centric -----------------
+    # for each (tet, corner k): v = tet[k]; if v is a top-removal target,
+    # simulate v -> kept_of[v] and test volumes / fold-over / new lengths.
+    tv = mesh.tet                                          # [T,4]
+    vpos = mesh.vert[tv]                                   # [T,4,3]
+    vt = rmpri[tv]                                         # [T,4] pri or 0
+    kept = kept_of[tv]                                     # [T,4]
+    kept_pos = mesh.vert[kept]                             # [T,4,3]
+    # does this tet also contain the kept vertex? then it dies, skip checks
+    contains_kept = jnp.zeros((capT, 4), bool)
+    for k in range(4):
+        hit = jnp.zeros((capT,), bool)
+        for j in range(4):
+            hit = hit | ((tv[:, j] == kept[:, k]) & (j != k))
+        contains_kept = contains_kept.at[:, k].set(hit)
+
+    geombad = jnp.zeros(capP + 1, bool)
+    newlong = jnp.zeros(capP + 1, bool)
+    for k in range(4):
+        active = (vt[:, k] > 0) & mesh.tmask & ~contains_kept[:, k]
+        p = vpos.at[:, k].set(kept_pos[:, k])              # moved corner
+        d1 = p[:, 1] - p[:, 0]
+        d2 = p[:, 2] - p[:, 0]
+        d3 = p[:, 3] - p[:, 0]
+        vol = jnp.einsum("ti,ti->t", d1, jnp.cross(d2, d3)) / 6.0
+        bad = vol <= EPSD
+        # fold-over: boundary faces containing corner k keep orientation
+        for f in range(4):
+            if k == f:
+                continue  # face opposite k does not contain k
+            idx = IDIR[f]
+            n_old = jnp.cross(vpos[:, idx[1]] - vpos[:, idx[0]],
+                              vpos[:, idx[2]] - vpos[:, idx[0]])
+            n_new = jnp.cross(p[:, idx[1]] - p[:, idx[0]],
+                              p[:, idx[2]] - p[:, idx[0]])
+            isb = (mesh.ftag[:, f] & MG_BDY) != 0
+            flip = jnp.sum(n_old * n_new, -1) <= 0
+            bad = bad | (isb & flip)
+        # overlong new edges from the kept vertex to the other corners
+        if met.ndim == 1:
+            from .quality import edge_length_iso
+            for j in range(4):
+                if j == k:
+                    continue
+                lnew = edge_length_iso(
+                    kept_pos[:, k], p[:, j],
+                    met[kept[:, k]], met[tv[:, j]])
+                bad_l = lnew > lmax
+                newlong = newlong.at[jnp.where(active, tv[:, k], capP)].max(
+                    bad_l, mode="drop")
+        geombad = geombad.at[jnp.where(active, tv[:, k], capP)].max(
+            bad, mode="drop")
+    geombad = geombad[:capP] | newlong[:capP]
+
+    # --- claims ----------------------------------------------------------
+    vclaim = jnp.zeros(capP, jnp.int32)
+    vclaim = vclaim.at[rm].max(jnp.where(cand, pri, 0))
+    vclaim = vclaim.at[kp].max(jnp.where(cand, pri, 0))
+    # tet claim = max removal-pri over its 4 corners
+    tclaim = jnp.max(vt, axis=1)
+    # bad claim: some tet of rm's ball is contested by a higher claim
+    contested = jnp.zeros(capP + 1, bool)
+    for k in range(4):
+        mism = (vt[:, k] > 0) & (tclaim != vt[:, k]) & mesh.tmask
+        contested = contested.at[
+            jnp.where(mesh.tmask, tv[:, k], capP)].max(mism, mode="drop")
+    contested = contested[:capP]
+
+    win = (cand & (pri == rmpri[rm]) & ~geombad[rm] & ~contested[rm]
+           & (pri == vclaim[rm]) & (pri == vclaim[kp]))
+
+    # --- apply: vertex remap + dead shell tets ---------------------------
+    remap = jnp.arange(capP, dtype=jnp.int32)
+    remap = remap.at[jnp.where(win, rm, capP)].set(kp, mode="drop")
+    new_tet = remap[mesh.tet]
+    # dead = any duplicated vertex pair (tet contained rm and kp)
+    dup = jnp.zeros(capT, bool)
+    for i in range(4):
+        for j in range(i + 1, 4):
+            dup = dup | (new_tet[:, i] == new_tet[:, j])
+    dead = dup & mesh.tmask
+    tmask = mesh.tmask & ~dead
+    vmask = mesh.vmask.at[jnp.where(win, rm, capP)].set(False, mode="drop")
+
+    # --- transfer face tags from dying tets to surviving neighbors -------
+    # the shared face sits at (nb, nf) on the other side; it survives there
+    nb = mesh.adja >> 2
+    nf = mesh.adja & 3
+    has_nb = mesh.adja >= 0
+    nb_safe = jnp.clip(nb, 0, capT - 1)
+    nb_dead = dead[nb_safe] & has_nb
+    # receiving side: tet alive, neighbor dying, neighbor's face tagged
+    recv = (~dead)[:, None] & nb_dead & mesh.tmask[:, None]
+    nbr_ftag = mesh.ftag[nb_safe, nf]
+    nbr_fref = mesh.fref[nb_safe, nf]
+    ftag = jnp.where(recv, mesh.ftag | nbr_ftag, mesh.ftag)
+    fref = jnp.where(recv & (nbr_fref != 0), nbr_fref, mesh.fref)
+
+    ncol = jnp.sum(win.astype(jnp.int32))
+    out = dataclasses.replace(
+        mesh, tet=new_tet, tmask=tmask, vmask=vmask, ftag=ftag, fref=fref)
+    return CollapseResult(out, ncol)

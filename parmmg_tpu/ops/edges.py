@@ -1,0 +1,110 @@
+"""Unique mesh edges and edge->tet incidence, sort-based (jittable).
+
+Replaces Mmg's edge hash tables (``MMG5_hashEdge`` family; the reference's
+parallel variants live in hash_pmmg.c:38-234) with the sort/segment idiom:
+all 6*capT tet edges are materialized, lexsorted by (min vid, max vid), and
+the first occurrence of each key becomes the representative unique edge.
+Every (tet, local-edge) slot learns its unique-edge id — that gather table is
+what the split/collapse/swap kernels use to look up per-edge decisions.
+"""
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from ..core.mesh import Mesh, tet_edge_vertices
+from ..core.constants import IARE
+
+_INT32_MAX = 2147483647
+
+
+class EdgeTable(NamedTuple):
+    """Unique edges of the mesh.  capE = 6*capT slots, masked.
+
+    ``edge_id[t, e]`` maps each tet-edge slot to its unique edge id
+    (garbage on invalid tets).  ``ev`` are the (min, max) vertex ids of the
+    unique edge; ``emask`` marks live unique-edge slots; ``etag`` is the OR
+    of the per-tet edge tags over all incident tets (tags must agree, the
+    OR makes the table robust to partially-propagated tags); ``nshell`` is
+    the number of incident tets (the shell size).
+    """
+    ev: jax.Array       # [capE, 2] int32
+    emask: jax.Array    # [capE] bool
+    etag: jax.Array     # [capE] uint32
+    nshell: jax.Array   # [capE] int32
+    edge_id: jax.Array  # [capT, 6] int32
+    shell3: jax.Array   # [capE, 3] int32 first 3 shell tet ids (-1 unused)
+
+
+def unique_edges(mesh: Mesh) -> EdgeTable:
+    capT = mesh.capT
+    ev = tet_edge_vertices(mesh.tet).reshape(capT * 6, 2)
+    a = jnp.minimum(ev[:, 0], ev[:, 1])
+    b = jnp.maximum(ev[:, 0], ev[:, 1])
+    valid = jnp.repeat(mesh.tmask, 6)
+    a = jnp.where(valid, a, _INT32_MAX)
+    b = jnp.where(valid, b, _INT32_MAX)
+    order = jnp.lexsort((b, a))
+    ka, kb = a[order], b[order]
+    first = jnp.concatenate([jnp.array([True]),
+                             (ka[1:] != ka[:-1]) | (kb[1:] != kb[:-1])])
+    # unique-edge id of each sorted slot = index of its segment head
+    seg_head = jnp.where(first, jnp.arange(capT * 6), 0)
+    seg_head = jax.lax.associative_scan(jnp.maximum, seg_head)
+    # representative id = position of the segment head in SORTED order; we
+    # use the sorted position itself as the unique edge id (stable, dense
+    # enough). Scatter back to (tet, local edge) slots.
+    eid_sorted = seg_head
+    eid = jnp.zeros(capT * 6, jnp.int32).at[order].set(
+        eid_sorted.astype(jnp.int32))
+    edge_id = eid.reshape(capT, 6)
+
+    emask = first & (ka != _INT32_MAX)
+    ev_u = jnp.stack([ka, kb], axis=1)
+    # shell size + tag OR per unique edge (segment sums via scatter-add)
+    ones = (valid[order]).astype(jnp.int32)
+    nshell = jnp.zeros(capT * 6, jnp.int32).at[eid_sorted].add(ones)
+    tags = mesh.etag.reshape(capT * 6)[order]
+    tags = jnp.where(valid[order], tags, 0)
+    etag = jnp.zeros(capT * 6, jnp.uint32).at[eid_sorted].max(tags)
+    # first-3 shell tet ids per edge (for 3-2 swaps): rank within segment
+    pos = jnp.arange(capT * 6)
+    rank = pos - seg_head
+    tet_of_slot = (order // 6).astype(jnp.int32)
+    shell3 = jnp.full((capT * 6, 3), -1, jnp.int32)
+    tgt_e = jnp.where(valid[order] & (rank < 3), eid_sorted, capT * 6)
+    shell3 = shell3.at[tgt_e, jnp.clip(rank, 0, 2)].set(
+        tet_of_slot, mode="drop")
+    return EdgeTable(ev=ev_u, emask=emask, etag=etag, nshell=nshell,
+                     edge_id=edge_id, shell3=shell3)
+
+
+def edge_lengths(mesh: Mesh, et: EdgeTable, met: jax.Array) -> jax.Array:
+    """[capE] metric length of each unique edge (garbage on dead slots)."""
+    from .quality import edge_length_iso, edge_length_ani
+    p0 = mesh.vert[jnp.clip(et.ev[:, 0], 0, mesh.capP - 1)]
+    p1 = mesh.vert[jnp.clip(et.ev[:, 1], 0, mesh.capP - 1)]
+    i0 = jnp.clip(et.ev[:, 0], 0, mesh.capP - 1)
+    i1 = jnp.clip(et.ev[:, 1], 0, mesh.capP - 1)
+    if met.ndim == 1:
+        return edge_length_iso(p0, p1, met[i0], met[i1])
+    return edge_length_ani(p0, p1, met[i0], met[i1])
+
+
+def unique_priority(score: jax.Array, mask: jax.Array) -> jax.Array:
+    """Turn a float score into a unique int32 priority (higher = better).
+
+    Ties are broken by slot index via argsort rank; masked slots get
+    priority 0.  Used by the independent-set claim resolution in the remesh
+    kernels (the parallel analogue of Mmg's sequential everything-in-order
+    application).
+    """
+    n = score.shape[0]
+    neg = jnp.where(mask, -score, jnp.inf)
+    order = jnp.argsort(neg)          # best (highest score) first
+    rank = jnp.zeros(n, jnp.int32).at[order].set(
+        jnp.arange(n, dtype=jnp.int32))
+    pri = n - rank                    # in [1, n], unique
+    return jnp.where(mask, pri, 0).astype(jnp.int32)

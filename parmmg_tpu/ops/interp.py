@@ -1,0 +1,185 @@
+"""Background-mesh localization and P1 interpolation.
+
+Reference semantics (/root/reference/src/locate_pmmg.c,
+interpmesh_pmmg.c, barycoord_pmmg.c): after each remesh iteration the
+metric and user solution fields are transferred from the *background* copy
+of the pre-remesh mesh onto the new vertices: each new vertex is located in
+the background tetrahedrization by an adjacency walk with barycentric sign
+tests (exhaustive + closest-element fallbacks), then P1-interpolated
+(``PMMG_interp4bar_iso``; for anisotropic metrics the *inverse* tensors are
+combined barycentrically and inverted back, interpmesh_pmmg.c:240-271).
+
+TPU design: the walk is a ``lax.while_loop`` vmapped over all query points
+(every point walks independently, all lanes advance in lockstep until the
+slowest converges); the exhaustive fallback is a masked argmax over all
+background tets, batched only over the failed points via a second pass.
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from ..core.mesh import Mesh
+from ..core.constants import EPSD
+
+
+class LocateResult(NamedTuple):
+    tet: jax.Array     # [M] int32 containing (or closest) background tet
+    bary: jax.Array    # [M,4] barycentric coordinates in that tet
+    failed: jax.Array  # [M] bool walk failed (fallback used)
+    steps: jax.Array   # [M] int32 walk steps (locateStats analogue)
+
+
+def _barycentric(bg_vert, bg_tet, tid, pt):
+    """Barycentric coords of pt in background tet tid (normalized)."""
+    tv = bg_tet[tid]
+    p = bg_vert[tv]                      # [4,3]
+    d1 = p[1] - p[0]
+    d2 = p[2] - p[0]
+    d3 = p[3] - p[0]
+    vol = jnp.sum(d1 * jnp.cross(d2, d3))
+    # face-opposite volumes
+    def sub(i):
+        q = p.at[i].set(pt)
+        e1 = q[1] - q[0]
+        e2 = q[2] - q[0]
+        e3 = q[3] - q[0]
+        return jnp.sum(e1 * jnp.cross(e2, e3))
+    vols = jnp.stack([sub(0), sub(1), sub(2), sub(3)])
+    return vols / jnp.where(jnp.abs(vol) > EPSD, vol, 1.0)
+
+
+def locate_points(bg: Mesh, points: jax.Array, start: jax.Array,
+                  max_steps: int = 256, tol: float = -1e-4) -> LocateResult:
+    """Walk-locate each point in the background mesh.
+
+    ``start``: [M] initial tet hints (the reference warm-starts from
+    ``point->src`` under USE_POINTMAP, locate_pmmg.c:931; callers pass the
+    creation-time parent tet or 0).
+    """
+    capT = bg.capT
+
+    def walk_one(pt, t0):
+        def cond(state):
+            t, done, steps, prev = state
+            return (~done) & (steps < max_steps)
+
+        def body(state):
+            t, done, steps, prev = state
+            bar = _barycentric(bg.vert, bg.tet, t, pt)
+            inside = jnp.min(bar) >= tol
+            worst = jnp.argmin(bar)
+            nxt_enc = bg.adja[t, worst]
+            nxt = nxt_enc >> 2
+            blocked = nxt_enc < 0
+            new_t = jnp.where(inside | blocked, t, nxt)
+            # dead end at boundary counts as done-but-failed; flag via prev
+            return (new_t.astype(jnp.int32), inside | blocked,
+                    steps + 1, jnp.where(blocked & ~inside, 1, prev))
+
+        t, done, steps, failflag = jax.lax.while_loop(
+            cond, body, (t0.astype(jnp.int32), False, 0, 0))
+        bar = _barycentric(bg.vert, bg.tet, t, pt)
+        ok = jnp.min(bar) >= tol
+        return t, bar, ~ok | (failflag == 1) & ~ok, steps
+
+    tids, bary, failed, steps = jax.vmap(walk_one)(points, start)
+
+    # --- exhaustive fallback for failed walks (argmax of min-barycoord) --
+    def exhaustive(pt):
+        tv = bg.tet
+        p = bg.vert[tv]                                   # [T,4,3]
+        d1 = p[:, 1] - p[:, 0]
+        d2 = p[:, 2] - p[:, 0]
+        d3 = p[:, 3] - p[:, 0]
+        vol = jnp.sum(d1 * jnp.cross(d2, d3), -1)
+        bars = []
+        for i in range(4):
+            q = p.at[:, i].set(pt)
+            e1 = q[:, 1] - q[:, 0]
+            e2 = q[:, 2] - q[:, 0]
+            e3 = q[:, 3] - q[:, 0]
+            bars.append(jnp.sum(e1 * jnp.cross(e2, e3), -1))
+        bar = jnp.stack(bars, 1) / jnp.where(
+            jnp.abs(vol)[:, None] > EPSD, vol[:, None], 1.0)
+        score = jnp.where(bg.tmask, jnp.min(bar, 1), -jnp.inf)
+        best = jnp.argmax(score)
+        return best.astype(jnp.int32), bar[best]
+
+    # run fallback for every point but only *use* it where failed (keeps
+    # shapes static; cost bounded by doing it in one batched pass)
+    fb_t, fb_b = jax.vmap(exhaustive)(points)
+    tids = jnp.where(failed, fb_t, tids)
+    bary = jnp.where(failed[:, None], fb_b, bary)
+    return LocateResult(tids, bary, failed, steps)
+
+
+# ---------------------------------------------------------------------------
+# P1 interpolation
+# ---------------------------------------------------------------------------
+def interp_p1(values: jax.Array, bg_tet: jax.Array, loc: LocateResult):
+    """P1-interpolate per-vertex values at located points.
+
+    values: [capP_bg, ...] -> returns [M, ...].
+    Barycentric coords are clipped to the simplex (closest-point semantics
+    of PMMG_barycoord*_getClosest for points that fell outside).
+    """
+    w = jnp.clip(loc.bary, 0.0, 1.0)
+    w = w / jnp.maximum(jnp.sum(w, -1, keepdims=True), EPSD)
+    tv = bg_tet[loc.tet]                                  # [M,4]
+    vals = values[tv]                                     # [M,4,...]
+    wexp = w.reshape(w.shape + (1,) * (vals.ndim - 2))
+    return jnp.sum(vals * wexp, axis=1)
+
+
+def interp_metric_ani(met6: jax.Array, bg_tet: jax.Array, loc: LocateResult):
+    """Aniso metric interpolation via inverse-tensor combination.
+
+    Exactly the reference scheme (interpmesh_pmmg.c:240-271): invert each
+    corner tensor, combine with barycentric weights, invert back.
+    """
+    from .quality import unpack_sym
+    w = jnp.clip(loc.bary, 0.0, 1.0)
+    w = w / jnp.maximum(jnp.sum(w, -1, keepdims=True), EPSD)
+    tv = bg_tet[loc.tet]
+    M = unpack_sym(met6[tv])                              # [M,4,3,3]
+    Minv = jnp.linalg.inv(M + jnp.eye(3) * EPSD)
+    comb = jnp.einsum("mk,mkij->mij", w, Minv)
+    out = jnp.linalg.inv(comb + jnp.eye(3) * EPSD)
+    return jnp.stack([out[:, 0, 0], out[:, 0, 1], out[:, 0, 2],
+                      out[:, 1, 1], out[:, 1, 2], out[:, 2, 2]], -1)
+
+
+def interpolate_from_background(bg: Mesh, bg_met: jax.Array,
+                                mesh: Mesh, met: jax.Array,
+                                bg_fields: jax.Array | None = None,
+                                only_new: jax.Array | None = None,
+                                start: jax.Array | None = None):
+    """Transfer metric (and fields) from a background mesh onto mesh's
+    vertices — the driver-level analogue of PMMG_interpMetricsAndFields
+    (interpmesh_pmmg.c:663).
+
+    ``only_new``: bool [capP] — vertices to overwrite (default: all valid);
+    others keep their current values (the reference copies unmoved/required
+    points directly, interpmesh_pmmg.c:432).
+    Returns (met', fields' or None, LocateResult).
+    """
+    sel = mesh.vmask if only_new is None else (only_new & mesh.vmask)
+    pts = mesh.vert
+    if start is None:
+        start = jnp.zeros(mesh.capP, jnp.int32)
+    loc = locate_points(bg, pts, start)
+    if bg_met.ndim == 1:
+        met_i = interp_p1(bg_met, bg.tet, loc)
+    else:
+        met_i = interp_metric_ani(bg_met, bg.tet, loc)
+    met_out = jnp.where(sel.reshape(sel.shape + (1,) * (met.ndim - 1)),
+                        met_i.astype(met.dtype), met)
+    fields_out = None
+    if bg_fields is not None:
+        f_i = interp_p1(bg_fields, bg.tet, loc)
+        fields_out = f_i
+    return met_out, fields_out, loc

@@ -1,0 +1,93 @@
+"""Metric synthesis, clamping and gradation.
+
+Reference semantics: Mmg computes a size map for ``-optim`` (local mean edge
+length) / ``-hsiz`` (constant), clamps to [hmin, hmax], and enforces size
+gradation ``-hgrad`` (bounded relative growth along edges).  ParMmg forwards
+these per group (API_functions_pmmg.c:531-830) and rejects some combos in
+``PMMG_check_inputData`` (libparmmg.c:55-101).  Here each is a vectorized
+kernel over the whole vertex array; gradation is an iterated scatter-min
+relaxation (a parallel fixpoint instead of Mmg's sequential edge sweeps).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.mesh import Mesh, tet_edge_vertices
+from ..core.constants import EPSD, HGRAD_DEFAULT
+
+
+def metric_hsiz(mesh: Mesh, hsiz: float) -> jax.Array:
+    """Constant target size (Mmg -hsiz)."""
+    return jnp.full(mesh.capP, hsiz, mesh.vert.dtype)
+
+
+def metric_optim(mesh: Mesh) -> jax.Array:
+    """Local mean incident-edge length per vertex (Mmg -optim).
+
+    Preserves the existing sizing of the mesh: adaptation then only
+    improves quality without refining/coarsening on average.
+    """
+    ev = tet_edge_vertices(mesh.tet).reshape(-1, 2)
+    p0 = mesh.vert[ev[:, 0]]
+    p1 = mesh.vert[ev[:, 1]]
+    l = jnp.sqrt(jnp.maximum(jnp.sum((p1 - p0) ** 2, -1), 0.0))
+    w = jnp.repeat(mesh.tmask, 6).astype(mesh.vert.dtype)
+    acc = jnp.zeros(mesh.capP + 1, mesh.vert.dtype)
+    cnt = jnp.zeros(mesh.capP + 1, mesh.vert.dtype)
+    for side in range(2):
+        idx = jnp.where(jnp.repeat(mesh.tmask, 6), ev[:, side], mesh.capP)
+        acc = acc.at[idx].add(l * w, mode="drop")
+        cnt = cnt.at[idx].add(w, mode="drop")
+    h = acc[:-1] / jnp.maximum(cnt[:-1], 1.0)
+    return jnp.where(mesh.vmask, h, 1.0)
+
+
+def clamp_metric(met: jax.Array, hmin: float, hmax: float) -> jax.Array:
+    if met.ndim == 1:
+        return jnp.clip(met, hmin, hmax)
+    # aniso: clamp eigenvalues of each tensor to [1/hmax^2, 1/hmin^2]
+    from .quality import unpack_sym
+    M = unpack_sym(met)
+    w, V = jnp.linalg.eigh(M)
+    w = jnp.clip(w, 1.0 / hmax**2, 1.0 / hmin**2)
+    Mc = jnp.einsum("...ij,...j,...kj->...ik", V, w, V)
+    return jnp.stack([Mc[..., 0, 0], Mc[..., 0, 1], Mc[..., 0, 2],
+                      Mc[..., 1, 1], Mc[..., 1, 2], Mc[..., 2, 2]], -1)
+
+
+def gradation(mesh: Mesh, met: jax.Array, hgrad: float = HGRAD_DEFAULT,
+              max_sweeps: int = 20) -> jax.Array:
+    """Bound relative size growth along edges (Mmg -hgrad, iso only).
+
+    Rule (Mmg MMG5_grad2met flavor): along an edge of euclidean length d,
+    h_b may not exceed h_a + (hgrad - 1) * d.  Enforced by Jacobi
+    scatter-min sweeps until stationary (bounded by max_sweeps); each sweep
+    is one fused gather/scatter — the parallel form of Mmg's sequential
+    edge relaxation.
+    """
+    if met.ndim != 1:
+        return met  # aniso gradation is a later milestone
+    ev = tet_edge_vertices(mesh.tet).reshape(-1, 2)
+    valid = jnp.repeat(mesh.tmask, 6)
+    p0 = mesh.vert[ev[:, 0]]
+    p1 = mesh.vert[ev[:, 1]]
+    d = jnp.sqrt(jnp.maximum(jnp.sum((p1 - p0) ** 2, -1), 0.0))
+    slope = hgrad - 1.0
+
+    def sweep(met, _):
+        h0 = met[ev[:, 0]]
+        h1 = met[ev[:, 1]]
+        cap0 = h1 + slope * d                 # bound on h at endpoint 0
+        cap1 = h0 + slope * d
+        out = met
+        big = jnp.inf
+        lim = jnp.full(met.shape[0] + 1, big, met.dtype)
+        lim = lim.at[jnp.where(valid, ev[:, 0], met.shape[0])].min(
+            cap0, mode="drop")
+        lim = lim.at[jnp.where(valid, ev[:, 1], met.shape[0])].min(
+            cap1, mode="drop")
+        return jnp.minimum(met, lim[:-1]), None
+
+    met, _ = jax.lax.scan(sweep, met, None, length=max_sweeps)
+    return met

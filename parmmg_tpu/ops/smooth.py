@@ -1,0 +1,92 @@
+"""Batched vertex smoothing — data-parallel replacement for Mmg's movtet.
+
+Reference behavior: ``MMG5_movtet`` relocates free vertices to improve local
+quality (volume barycenter moves for interior points, tangential moves for
+surface points), never degrading the worst quality of the ball; required /
+corner / parallel-interface points are frozen (the ParMmg contract,
+tag_pmmg.c:39-124).
+
+Wave scheme: every movable vertex proposes the quality-weighted centroid of
+its ball; validity (ball min-quality must not decrease) is checked
+tet-centrically; a hash-rotated independent set (vertex claims all its ball
+tets) moves per wave so the precheck remains exact under simultaneous moves.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from ..core.mesh import Mesh
+from ..core.constants import (
+    MG_BDY, MG_CRN, MG_GEO, MG_REQ, MG_PARBDY, QUAL_FLOOR)
+from .quality import quality_from_points
+from .edges import unique_priority
+
+
+class SmoothResult(NamedTuple):
+    mesh: Mesh
+    nmoved: jax.Array
+
+
+def smooth_wave(mesh: Mesh, met: jax.Array, wave: int = 0,
+                relax: float = 1.0) -> SmoothResult:
+    capT, capP = mesh.capT, mesh.capP
+    movable = mesh.vmask & ((mesh.vtag &
+                             (MG_BDY | MG_REQ | MG_CRN | MG_PARBDY)) == 0)
+
+    tv = mesh.tet
+    vpos = mesh.vert[tv]                                   # [T,4,3]
+    centroid = jnp.mean(vpos, axis=1)                      # [T,3]
+    # proposal: mean of ball-tet centroids (volume-barycenter flavor of
+    # MMG5_movintpt)
+    acc = jnp.zeros((capP + 1, 3), mesh.vert.dtype)
+    cnt = jnp.zeros((capP + 1,), mesh.vert.dtype)
+    for k in range(4):
+        idx = jnp.where(mesh.tmask, tv[:, k], capP)
+        acc = acc.at[idx].add(centroid, mode="drop")
+        cnt = cnt.at[idx].add(1.0, mode="drop")
+    prop = acc[:capP] / jnp.maximum(cnt[:capP, None], 1.0)
+    newpos = mesh.vert + relax * (prop - mesh.vert)
+    newpos = jnp.where(movable[:, None], newpos, mesh.vert)
+
+    # --- validity: per-ball min quality must not decrease ----------------
+    if met.ndim == 1:
+        from .quality import iso_to_tensor
+        m6 = iso_to_tensor(met)
+    else:
+        m6 = met
+    mq = m6[tv]                                            # [T,4,6]
+    q_old = quality_from_points(vpos, mq)                  # [T]
+    minq_old = jnp.full(capP + 1, jnp.inf, mesh.vert.dtype)
+    minq_new = jnp.full(capP + 1, jnp.inf, mesh.vert.dtype)
+    for k in range(4):
+        idx = jnp.where(mesh.tmask, tv[:, k], capP)
+        p_k = vpos.at[:, k].set(newpos[tv[:, k]])
+        q_new = quality_from_points(p_k, mq)
+        minq_old = minq_old.at[idx].min(
+            jnp.where(mesh.tmask, q_old, jnp.inf), mode="drop")
+        minq_new = minq_new.at[idx].min(
+            jnp.where(mesh.tmask, q_new, jnp.inf), mode="drop")
+    improves = (minq_new[:capP] > jnp.maximum(minq_old[:capP],
+                                              QUAL_FLOOR)) & movable
+
+    # --- independent set: vertex claims its ball tets --------------------
+    wv = jnp.asarray(wave, jnp.uint32)
+    h = (jnp.arange(capP, dtype=jnp.uint32) * jnp.uint32(2654435761)
+         + (wv * jnp.uint32(40503) + jnp.uint32(1))) & jnp.uint32(0x7FFFFFFF)
+    pri = unique_priority(h.astype(jnp.float32), improves)
+    vpri = jnp.where(improves, pri, 0)
+    tclaim = jnp.max(jnp.where(mesh.tmask[:, None], vpri[tv], 0), axis=1)
+    lost = jnp.zeros(capP + 1, bool)
+    for k in range(4):
+        idx = jnp.where(mesh.tmask, tv[:, k], capP)
+        mism = (vpri[tv[:, k]] > 0) & (tclaim != vpri[tv[:, k]])
+        lost = lost.at[idx].max(mism, mode="drop")
+    win = improves & ~lost[:capP]
+
+    vert = jnp.where(win[:, None], newpos, mesh.vert)
+    return SmoothResult(dataclasses.replace(mesh, vert=vert),
+                        jnp.sum(win.astype(jnp.int32)))

@@ -1,0 +1,246 @@
+"""Batched edge split — data-parallel replacement for Mmg's split cascade.
+
+Reference behavior being reproduced: inside ``MMG5_mmg3d1_delone`` (called by
+the group loop at /root/reference/src/libparmmg1.c:737-739) long edges
+(metric length > sqrt(2)) are split by inserting a point, and every tet of
+the edge's shell is cut in two; entities tagged ``MG_REQ`` (in particular the
+frozen parallel interface, tag_pmmg.c:39-124) must not be touched.
+
+TPU design: instead of a sequential cascade, each *wave* selects a maximal
+independent set of splittable edges (no two in the same tet) and applies all
+of them at once:
+
+1.  every tet nominates its longest splittable edge;
+2.  an edge wins iff **all** tets of its shell nominated it (so the whole
+    shell splits coherently and each tet is modified by at most one split);
+3.  winning edges allocate midpoints (prefix-sum slot assignment) and each
+    shell tet is cut in two, tags inherited per the local topology tables.
+
+Determinism: priorities are unique int32 ranks, so the independent set — and
+hence the output mesh — is a pure function of the input.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from ..core.mesh import Mesh
+from ..core.constants import (
+    IARE, EDGE_FACES, FACE_EDGES, IDIR, LLONG, MG_BDY, MG_GEO, MG_REQ,
+    MG_PARBDY, MG_REF)
+from .edges import EdgeTable, unique_edges, edge_lengths, unique_priority
+
+_IARE_J = jnp.asarray(IARE)
+
+
+class SplitResult(NamedTuple):
+    mesh: Mesh
+    met: jax.Array
+    nsplit: jax.Array      # scalar int32: number of edges split
+    overflow: jax.Array    # scalar bool: capacity exhausted, wave truncated
+
+
+def _interp_met_mid(met, va, vb):
+    """Metric at an edge midpoint (linear interpolation of the metric
+    coefficients; MMG5_intmet semantics simplified to P1)."""
+    return 0.5 * (met[va] + met[vb])
+
+
+def split_wave(mesh: Mesh, met: jax.Array, lmax: float = LLONG,
+               frozen_vtag: int = MG_REQ | MG_PARBDY) -> SplitResult:
+    """One independent-set split wave. Jittable; static shapes throughout."""
+    capT, capP = mesh.capT, mesh.capP
+    et = unique_edges(mesh)
+    lens = edge_lengths(mesh, et, met)
+
+    # --- candidate edges -------------------------------------------------
+    va = jnp.clip(et.ev[:, 0], 0, capP - 1)
+    vb = jnp.clip(et.ev[:, 1], 0, capP - 1)
+    frozen_edge = (et.etag & (MG_REQ | MG_PARBDY)) != 0
+    cand = et.emask & (lens > lmax) & ~frozen_edge
+    pri = unique_priority(lens, cand)                 # [capE]
+
+    # --- nomination: each tet picks its highest-priority candidate edge --
+    tet_edge_pri = pri[et.edge_id]                    # [capT,6]
+    tet_edge_pri = jnp.where(mesh.tmask[:, None], tet_edge_pri, 0)
+    best = jnp.max(tet_edge_pri, axis=1)              # [capT]
+    nominate = (tet_edge_pri == best[:, None]) & (best[:, None] > 0)
+
+    # --- an edge wins iff nominated by its whole shell -------------------
+    capE = et.ev.shape[0]
+    nom_count = jnp.zeros(capE, jnp.int32).at[et.edge_id.reshape(-1)].add(
+        nominate.reshape(-1).astype(jnp.int32))
+    win = cand & (nom_count == et.nshell) & (et.nshell > 0)
+
+    # --- allocate midpoint vertices --------------------------------------
+    win_i = win.astype(jnp.int32)
+    new_off = jnp.cumsum(win_i) - win_i               # prefix index per win
+    nwin = jnp.sum(win_i)
+    free_p = capP - mesh.npoin
+    # capacity guard: drop lowest-priority winners that don't fit
+    fits_p = new_off < free_p
+    # each winning edge adds nshell tets; prefix over shells
+    shell_add = jnp.where(win & fits_p, et.nshell, 0)
+    tet_off = jnp.cumsum(shell_add) - shell_add
+    free_t = capT - mesh.nelem
+    fits_t = (tet_off + shell_add) <= free_t
+    win = win & fits_p & fits_t
+    overflow = (nwin > 0) & (jnp.sum(win) < nwin)
+    # recompute offsets over the final winner set
+    win_i = win.astype(jnp.int32)
+    new_off = jnp.cumsum(win_i) - win_i
+    shell_add = jnp.where(win, et.nshell, 0)
+    tet_off = jnp.cumsum(shell_add) - shell_add
+    nwin = jnp.sum(win_i)
+
+    mid_id = (mesh.npoin + new_off).astype(jnp.int32)  # [capE] vertex slot
+    # midpoint coordinates / refs / tags
+    pa, pb = mesh.vert[va], mesh.vert[vb]
+    mid = 0.5 * (pa + pb)
+    upd = win
+    vert = _scatter_rows(mesh.vert, mid_id, mid, upd)
+    vmask = _scatter_rows(mesh.vmask, mid_id,
+                          jnp.ones(mid_id.shape[0], bool), upd)
+    # the new point inherits the edge's tags (a point on a ridge edge is a
+    # ridge point, on a boundary edge a boundary point, ...)
+    vtag = _scatter_rows(mesh.vtag, mid_id, et.etag, upd)
+    vref = _scatter_rows(mesh.vref, mid_id,
+                         jnp.minimum(mesh.vref[va], mesh.vref[vb]), upd)
+    metm = _interp_met_mid(met, va, vb)
+    met_new = _scatter_rows(met, mid_id, metm, upd)
+
+    # --- split shell tets -------------------------------------------------
+    # per (tet, local edge): is my edge winning, and bookkeeping
+    e_win = win[et.edge_id] & mesh.tmask[:, None]          # [capT,6]
+    # at most one winning edge per tet (guaranteed); its local index:
+    loc_e = jnp.argmax(e_win, axis=1)                      # [capT]
+    has = jnp.any(e_win, axis=1)
+    eid = et.edge_id[jnp.arange(capT), loc_e]              # unique edge id
+    m_id = jnp.clip(mid_id[eid], 0, capP - 1)              # midpoint vid
+
+    # rank of this tet within its shell -> new tet slot
+    # order within shell: by tet id (scatter-add trick: stable prefix)
+    # compute per-tet slot = tet_off[eid] + (rank of tet among shell tets)
+    shell_rank = _rank_within_groups(eid, has, capE)
+    new_tid = (mesh.nelem + tet_off[eid] + shell_rank).astype(jnp.int32)
+
+    i_loc = _IARE_J[loc_e, 0]                              # local idx of a
+    j_loc = _IARE_J[loc_e, 1]
+    tvert = mesh.tet
+    ar = jnp.arange(capT)
+    # tet1 (in place): vertex j -> m ; tet2 (new slot): vertex i -> m
+    tet1 = tvert.at[ar, j_loc].set(jnp.where(has, m_id, tvert[ar, j_loc]))
+    tet2_rows = tvert.at[ar, i_loc].set(m_id)              # full rows
+    tet_out = _scatter_rows(tet1, new_tid, tet2_rows, has)
+    tmask = _scatter_rows(mesh.tmask, new_tid,
+                          jnp.ones(new_tid.shape[0], bool), has)
+    tref = _scatter_rows(mesh.tref, new_tid, mesh.tref, has)
+
+    # --- tag inheritance --------------------------------------------------
+    # tet1 keeps its ftag/etag except: the cut face (opposite i) becomes
+    # interior (tag 0); the half edges adjacent to the cut inherit; new
+    # edges (m,c) inside an old face f inherit that face's boundary bit.
+    ftag1, fref1, etag1, ftag2, fref2, etag2 = _split_tags(
+        mesh, loc_e, i_loc, j_loc, has)
+    ftag = _scatter_rows(ftag1, new_tid, ftag2, has)
+    frf = _scatter_rows(fref1, new_tid, fref2, has)
+    etag_out = _scatter_rows(etag1, new_tid, etag2, has)
+
+    npoin = mesh.npoin + nwin
+    nelem = mesh.nelem + jnp.sum(jnp.where(has, 1, 0), dtype=jnp.int32)
+    out = dataclasses.replace(
+        mesh, vert=vert, vmask=vmask, vtag=vtag, vref=vref,
+        tet=tet_out, tmask=tmask, tref=tref,
+        ftag=ftag, fref=frf, etag=etag_out,
+        npoin=npoin.astype(jnp.int32), nelem=nelem.astype(jnp.int32))
+    return SplitResult(out, met_new, nwin, overflow)
+
+
+def _scatter_rows(dst, idx, rows, mask):
+    """dst[idx] = rows where mask; masked-out rows are dropped (OOB trick).
+
+    ``mode="drop"`` gives a race-free masked scatter: rows with mask False
+    are sent out of bounds and discarded, so no identity-write can collide
+    with a real write on the same slot.
+    """
+    safe = jnp.where(mask, idx, dst.shape[0])
+    return dst.at[safe].set(rows, mode="drop")
+
+
+def _rank_within_groups(gid: jax.Array, mask: jax.Array, ngroups: int):
+    """rank of element i among elements with the same gid (masked), by index.
+
+    Sort-based: stable sort by gid keeps index order within groups.
+    """
+    n = gid.shape[0]
+    key = jnp.where(mask, gid, ngroups)
+    order = jnp.argsort(key, stable=True)
+    ks = key[order]
+    first = jnp.concatenate([jnp.array([True]), ks[1:] != ks[:-1]])
+    pos = jnp.arange(n)
+    head = jnp.where(first, pos, 0)
+    head = jax.lax.associative_scan(jnp.maximum, head)
+    rank_sorted = pos - head
+    rank = jnp.zeros(n, jnp.int32).at[order].set(rank_sorted.astype(jnp.int32))
+    return rank
+
+
+def _split_tags(mesh: Mesh, loc_e, i_loc, j_loc, has):
+    """Tag inheritance for the two halves of each split tet.
+
+    For split edge at local (i,j) with midpoint m:
+      tet1 = tet with v_j := m, tet2 = tet with v_i := m.
+      - face opposite the replaced vertex is the *outer* original face
+        (unchanged): inherits.
+      - faces k not in {i,j} are cut in half: inherit original face k tags.
+      - the cut face (opposite the kept edge endpoint) is interior: tag 0.
+      - edges: the split edge's halves inherit its tag; new edges m-c lie
+        inside original faces: they get MG_BDY/MG_REF iff that face has it;
+        other edges inherit.
+    """
+    capT = mesh.capT
+    ar = jnp.arange(capT)
+
+    def one_half(repl):  # repl = local vertex replaced by m (j for tet1)
+        kept = jnp.where(repl == i_loc, j_loc, i_loc)
+        ftag = mesh.ftag
+        fref = mesh.fref
+        # cut face = face opposite `kept` -> interior
+        ftag = ftag.at[ar, kept].set(jnp.where(has, 0, ftag[ar, kept]))
+        fref = fref.at[ar, kept].set(jnp.where(has, 0, fref[ar, kept]))
+        # edges: for each local edge, decide inheritance
+        etag = mesh.etag
+        # new edges: edges incident to `repl` other than the split edge now
+        # connect m to the two off-edge vertices c,d: edge (repl, c).  Such
+        # an edge lies inside the original face containing {i, j, c}; that
+        # face is the face opposite d, i.e. the face (of the two
+        # EDGE_FACES of the split edge) that contains c.
+        # We compute: for local edge el=(repl, other): if other not in
+        # {i,j}: the original face containing i, j, other is opposite the
+        # remaining vertex.
+        out = etag
+        for el in range(6):
+            a, b = int(IARE[el][0]), int(IARE[el][1])
+            av = jnp.int32(a)
+            bv = jnp.int32(b)
+            touches_repl = (av == repl) | (bv == repl)
+            other = jnp.where(av == repl, bv, av)
+            is_split_edge = ((av == i_loc) & (bv == j_loc)) | \
+                            ((av == j_loc) & (bv == i_loc))
+            # remaining vertex = the one not in {i, j, other}
+            s = i_loc + j_loc + other
+            rem = (jnp.int32(6) - s).astype(jnp.int32)  # 0+1+2+3 = 6
+            in_old_face = touches_repl & ~is_split_edge & \
+                (other != i_loc) & (other != j_loc)
+            face_t = mesh.ftag[ar, jnp.clip(rem, 0, 3)]
+            new_t = (face_t & (MG_BDY | MG_REF)).astype(jnp.uint32)
+            val = jnp.where(in_old_face & has, new_t, out[:, el])
+            out = out.at[:, el].set(val)
+        return ftag, fref, out
+
+    ftag1, fref1, etag1 = one_half(j_loc)
+    ftag2, fref2, etag2 = one_half(i_loc)
+    return ftag1, fref1, etag1, ftag2, fref2, etag2

@@ -1,0 +1,245 @@
+"""Batched topology swaps (3-2 edge swap, 2-3 face swap).
+
+Reference behavior: Mmg's ``MMG5_swpmsh``/``MMG3D_swpmshcpy`` remove bad
+configurations by re-triangulating small cavities around an edge or face
+when the worst quality strictly improves; the frozen-interface contract
+(tag_pmmg.c:39-124) keeps parallel entities untouched.
+
+v1 scope: swaps run only on *fully interior, untagged* cavities (no shell
+tet carries face/edge tags), which sidesteps tag re-routing; boundary-aware
+swaps are a later milestone.  Improvement gate: new worst quality >
+SWAP_GAIN * old worst (Mmg uses 1.053).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from ..core.mesh import Mesh
+from ..core.constants import EPSD, QUAL_FLOOR
+from .edges import unique_edges, unique_priority
+from .quality import quality_from_points, iso_to_tensor
+
+SWAP_GAIN = 1.053
+
+
+class SwapResult(NamedTuple):
+    mesh: Mesh
+    nswap: jax.Array
+
+
+def _met6(met):
+    return iso_to_tensor(met) if met.ndim == 1 else met
+
+
+def swap32_wave(mesh: Mesh, met: jax.Array) -> SwapResult:
+    """3-to-2 swap: interior edges with exactly 3 shell tets.
+
+    Shell T1=(a,b,p,q), T2, T3 around edge (a,b) with ring (p,q,r) is
+    replaced by tets (p,q,r,a') and (p,q,r,b') — two slots reused, one
+    freed.
+    """
+    capT, capP = mesh.capT, mesh.capP
+    et = unique_edges(mesh)
+    m6 = _met6(met)
+
+    t0, t1, t2 = et.shell3[:, 0], et.shell3[:, 1], et.shell3[:, 2]
+    s0, s1, s2 = (jnp.clip(t0, 0, capT - 1), jnp.clip(t1, 0, capT - 1),
+                  jnp.clip(t2, 0, capT - 1))
+    cand = et.emask & (et.nshell == 3) & (et.etag == 0) & \
+        (t0 >= 0) & (t1 >= 0) & (t2 >= 0)
+    # untagged cavity only
+    for s in (s0, s1, s2):
+        cand = cand & (jnp.sum(mesh.ftag[s], axis=1) == 0) & \
+            (jnp.sum(mesh.etag[s], axis=1) == 0)
+
+    a = jnp.clip(et.ev[:, 0], 0, capP - 1)
+    b = jnp.clip(et.ev[:, 1], 0, capP - 1)
+
+    def opp_pair(ts):
+        """the 2 vertices of tet ts not equal to a or b."""
+        tv = mesh.tet[ts]                               # [E,4]
+        is_ab = (tv == a[:, None]) | (tv == b[:, None])
+        # gather the two non-ab corners (positions via argsort of is_ab)
+        ordr = jnp.argsort(is_ab.astype(jnp.int32), axis=1, stable=True)
+        return tv[jnp.arange(tv.shape[0])[:, None], ordr[:, :2]]
+
+    pq = opp_pair(s0)                                   # [E,2] = (p,q)
+    rs = opp_pair(s1)
+    # r = vertex of T2 not in {p,q}
+    r = jnp.where((rs[:, 0] != pq[:, 0]) & (rs[:, 0] != pq[:, 1]),
+                  rs[:, 0], rs[:, 1])
+    p, q = pq[:, 0], pq[:, 1]
+
+    def signed_vol(v0, v1, v2, v3):
+        p0, p1, p2, p3 = (mesh.vert[v0], mesh.vert[v1], mesh.vert[v2],
+                          mesh.vert[v3])
+        return jnp.sum((p1 - p0) * jnp.cross(p2 - p0, p3 - p0), -1)
+
+    # validity: a and b strictly on opposite sides of plane (p,q,r) — the
+    # swapped pair tiles the shell union only then
+    vol_a = signed_vol(p, q, r, a)
+    vol_b = signed_vol(p, q, r, b)
+    cand = cand & (vol_a * vol_b < 0) & (jnp.abs(vol_a) > EPSD) & \
+        (jnp.abs(vol_b) > EPSD)
+    # same region on all shell tets
+    cand = cand & (mesh.tref[s0] == mesh.tref[s1]) & \
+        (mesh.tref[s0] == mesh.tref[s2])
+
+    def orient_from_sign(v0, v1, v2, v3, vol):
+        neg = vol < 0
+        w0 = jnp.where(neg, v1, v0)
+        w1 = jnp.where(neg, v0, v1)
+        return jnp.stack([w0, w1, v2, v3], axis=1)      # [E,4]
+
+    new_a = orient_from_sign(p, q, r, a, vol_a)
+    new_b = orient_from_sign(p, q, r, b, vol_b)
+
+    def qual(tets):
+        pts = mesh.vert[tets]
+        return quality_from_points(pts, m6[tets])
+
+    q_old = jnp.minimum(jnp.minimum(qual(mesh.tet[s0]), qual(mesh.tet[s1])),
+                        qual(mesh.tet[s2]))
+    q_new = jnp.minimum(qual(new_a), qual(new_b))
+    cand = cand & (q_new > jnp.maximum(SWAP_GAIN * q_old, QUAL_FLOOR))
+
+    # --- claims: the 3 shell tets, exclusively ---------------------------
+    pri = unique_priority(q_new - q_old, cand)
+    tclaim = jnp.zeros(capT + 1, jnp.int32)
+    for s, t in ((s0, t0), (s1, t1), (s2, t2)):
+        tclaim = tclaim.at[jnp.where(cand, s, capT)].max(pri, mode="drop")
+    win = cand
+    for s in (s0, s1, s2):
+        win = win & (tclaim[s] == pri)
+
+    # --- apply: overwrite slots t0,t1; kill t2 ---------------------------
+    tet = mesh.tet
+    tet = tet.at[jnp.where(win, s0, capT)].set(new_a, mode="drop")
+    tet = tet.at[jnp.where(win, s1, capT)].set(new_b, mode="drop")
+    tmask = mesh.tmask.at[jnp.where(win, s2, capT)].set(False, mode="drop")
+    # cavity was untagged: clear tags on rewritten slots
+    zero4 = jnp.zeros((et.ev.shape[0], 4), jnp.uint32)
+    zero6 = jnp.zeros((et.ev.shape[0], 6), jnp.uint32)
+    ftag = mesh.ftag
+    etag = mesh.etag
+    for s in (s0, s1):
+        ftag = ftag.at[jnp.where(win, s, capT)].set(zero4, mode="drop")
+        etag = etag.at[jnp.where(win, s, capT)].set(zero6, mode="drop")
+    nsw = jnp.sum(win.astype(jnp.int32))
+    out = dataclasses.replace(mesh, tet=tet, tmask=tmask, ftag=ftag,
+                              etag=etag,
+                              nelem=mesh.nelem)  # count unchanged (masked)
+    return SwapResult(out, nsw)
+
+
+def swap23_wave(mesh: Mesh, met: jax.Array) -> SwapResult:
+    """2-to-3 swap: interior faces whose two tets improve as an edge fan.
+
+    Tets T1, T2 share interior face (p,q,r) with apexes a (in T1) and b (in
+    T2); replaced by (a,b,p,q), (a,b,q,r), (a,b,r,p) — two slots reused,
+    one allocated.
+    """
+    capT, capP = mesh.capT, mesh.capP
+    m6 = _met6(met)
+    adja = mesh.adja
+    nb = adja >> 2
+    nf = adja & 3
+    valid = (adja >= 0) & mesh.tmask[:, None]
+    nb_s = jnp.clip(nb, 0, capT - 1)
+    # one candidate per interior face, owned by the lower tet id
+    tid = jnp.arange(capT, dtype=jnp.int32)[:, None]
+    own = valid & (tid < nb) & mesh.tmask[nb_s]
+    # untagged cavity
+    clean = (jnp.sum(mesh.ftag, axis=1) == 0) & \
+            (jnp.sum(mesh.etag, axis=1) == 0)
+    own = own & clean[:, None] & clean[nb_s]
+
+    flat = lambda x: x.reshape(-1)
+    F = capT * 4
+    t1 = jnp.repeat(jnp.arange(capT, dtype=jnp.int32), 4)
+    f1 = jnp.tile(jnp.arange(4, dtype=jnp.int32), capT)
+    t2 = flat(nb_s)
+    f2 = flat(nf)
+    cand = flat(own)
+
+    from ..core.constants import IDIR
+    idir = jnp.asarray(IDIR)
+    tv1 = mesh.tet[t1]                                   # [F,4]
+    tv2 = mesh.tet[t2]
+    pqr = tv1[jnp.arange(F)[:, None], idir[f1]]          # [F,3]
+    a = tv1[jnp.arange(F), f1]                           # apex in T1
+    b = tv2[jnp.arange(F), f2]                           # apex in T2
+
+    p, q, r = pqr[:, 0], pqr[:, 1], pqr[:, 2]
+
+    def mk(v0, v1, v2, v3):
+        return jnp.stack([v0, v1, v2, v3], axis=1)
+
+    # Face (p,q,r) = IDIR[f1] is oriented outward from T1 (away from a),
+    # so for a visible pair the ring tets (x, y, a, b) over ring edges
+    # (p,q), (q,r), (r,p) are all positively oriented; requiring all three
+    # volumes strictly positive IS the convexity (visibility) test — no
+    # sign fixing, which would mask invalid concave configurations.
+    def signed_vol(tets):
+        pts = mesh.vert[tets]
+        d1 = pts[:, 1] - pts[:, 0]
+        d2 = pts[:, 2] - pts[:, 0]
+        d3 = pts[:, 3] - pts[:, 0]
+        return jnp.sum(d1 * jnp.cross(d2, d3), -1)
+
+    n1 = mk(p, q, a, b)
+    n2 = mk(q, r, a, b)
+    n3 = mk(r, p, a, b)
+    pos = (signed_vol(n1) > EPSD) & (signed_vol(n2) > EPSD) & \
+          (signed_vol(n3) > EPSD)
+    # same region on both tets
+    cand = cand & (mesh.tref[t1] == mesh.tref[t2])
+
+    def qual(tets):
+        pts = mesh.vert[tets]
+        return quality_from_points(pts, m6[tets])
+
+    q_old = jnp.minimum(qual(tv1), qual(tv2))
+    q_new = jnp.minimum(jnp.minimum(qual(n1), qual(n2)), qual(n3))
+    cand = cand & pos & (q_new > jnp.maximum(SWAP_GAIN * q_old, QUAL_FLOOR))
+
+    # --- capacity for the third tet --------------------------------------
+    pri = unique_priority(q_new - q_old, cand)
+    # claims on both tets
+    tclaim = jnp.zeros(capT + 1, jnp.int32)
+    tclaim = tclaim.at[jnp.where(cand, t1, capT)].max(pri, mode="drop")
+    tclaim = tclaim.at[jnp.where(cand, t2, capT)].max(pri, mode="drop")
+    win = cand & (tclaim[t1] == pri) & (tclaim[t2] == pri)
+    w_i = win.astype(jnp.int32)
+    off = jnp.cumsum(w_i) - w_i
+    fits = off < (capT - mesh.nelem)
+    win = win & fits
+    w_i = win.astype(jnp.int32)
+    off = jnp.cumsum(w_i) - w_i
+    t3 = (mesh.nelem + off).astype(jnp.int32)
+
+    tet = mesh.tet
+    tet = tet.at[jnp.where(win, t1, capT)].set(n1, mode="drop")
+    tet = tet.at[jnp.where(win, t2, capT)].set(n2, mode="drop")
+    tet = tet.at[jnp.where(win, t3, capT)].set(n3, mode="drop")
+    tmask = mesh.tmask.at[jnp.where(win, t3, capT)].set(True, mode="drop")
+    tref3 = mesh.tref[t1]
+    tref = mesh.tref.at[jnp.where(win, t3, capT)].set(tref3, mode="drop")
+    zero4 = jnp.zeros((F, 4), jnp.uint32)
+    zero6 = jnp.zeros((F, 6), jnp.uint32)
+    ftag, etag, fref = mesh.ftag, mesh.etag, mesh.fref
+    for tt in (t1, t2, t3):
+        ftag = ftag.at[jnp.where(win, tt, capT)].set(zero4, mode="drop")
+        etag = etag.at[jnp.where(win, tt, capT)].set(zero6, mode="drop")
+        fref = fref.at[jnp.where(win, tt, capT)].set(
+            zero4.astype(jnp.int32), mode="drop")
+    nsw = jnp.sum(w_i)
+    nelem = mesh.nelem + nsw
+    out = dataclasses.replace(mesh, tet=tet, tmask=tmask, tref=tref,
+                              ftag=ftag, etag=etag, fref=fref,
+                              nelem=nelem.astype(jnp.int32))
+    return SwapResult(out, nsw)

@@ -1,0 +1,161 @@
+"""SPMD distributed adaptation over a jax.sharding.Mesh.
+
+The TPU-native replacement for ParMmg's MPI layer: where the reference runs
+one MPI rank per subdomain with Sendrecv exchanges and
+``MPI_Allreduce(MIN, ier)`` phase agreement (the status-agreement idiom,
+/root/reference/src/libparmmg1.c:812,876,912), we run one *shard* per
+device under ``shard_map``: every device executes the identical jitted
+adapt program on its shard; cross-shard agreement (op counters, error
+status, quality histograms) is a ``psum`` over the 'shard' axis — the
+collective rides ICI instead of MPI.
+
+During shard-local adaptation the interfaces are frozen (MG_PARBDY tags set
+by distribute.py), so no halo exchange is needed *inside* the hot loop —
+exactly the reference's design (interfaces remeshed only after migration).
+Repartitioning/migration between outer iterations is host-side DCN
+orchestration (SURVEY §5: dynamic-topology group migration stays off the
+static-shape device path).
+"""
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh as DeviceMesh, PartitionSpec as P, NamedSharding
+from jax.experimental.shard_map import shard_map
+
+from ..core.mesh import Mesh
+from ..ops.adjacency import build_adjacency
+from ..ops.split import split_wave
+from ..ops.collapse import collapse_wave
+from ..ops.swap import swap32_wave, swap23_wave
+from ..ops.smooth import smooth_wave
+from ..ops.quality import tet_quality, quality_histogram
+
+
+def _unstack(pytree):
+    return jax.tree.map(lambda x: x[0], pytree)
+
+
+def _restack(pytree):
+    return jax.tree.map(lambda x: x[None], pytree)
+
+
+def make_device_mesh(n_devices: int | None = None) -> DeviceMesh:
+    devs = jax.devices()
+    if n_devices is not None:
+        devs = devs[:n_devices]
+    return DeviceMesh(np.array(devs), ("shard",))
+
+
+def shard_stacked(stacked, dmesh: DeviceMesh):
+    """Place a [D, ...]-stacked pytree with leading axis over 'shard'."""
+    sh = NamedSharding(dmesh, P("shard"))
+    return jax.tree.map(lambda x: jax.device_put(x, sh), stacked)
+
+
+def dist_adapt_cycle(dmesh: DeviceMesh):
+    """Build the jitted SPMD adapt step for a given device mesh.
+
+    Returns fn(stacked_mesh, stacked_met, wave) ->
+      (stacked_mesh, stacked_met, global_counts[4], any_overflow).
+    """
+    spec = P("shard")
+
+    def local_cycle(mesh_s: Mesh, met_s, wave):
+        mesh = _unstack(mesh_s)
+        met = met_s[0]
+        res = split_wave(mesh, met)
+        mesh, met = res.mesh, res.met
+        mesh = build_adjacency(mesh)
+        col = collapse_wave(mesh, met)
+        mesh = build_adjacency(col.mesh)
+        s32 = swap32_wave(mesh, met)
+        mesh = build_adjacency(s32.mesh)
+        s23 = swap23_wave(mesh, met)
+        mesh = build_adjacency(s23.mesh)
+        for w in range(2):
+            sm = smooth_wave(mesh, met, wave=wave * 2 + w)
+            mesh = sm.mesh
+        # global agreement — the psum analogue of Allreduce(ier/counters)
+        counts = jnp.stack([res.nsplit, col.ncollapse,
+                            s32.nswap + s23.nswap, sm.nmoved])
+        counts = jax.lax.psum(counts, "shard")
+        ovf = jax.lax.pmax(res.overflow.astype(jnp.int32), "shard")
+        return _restack(mesh), met[None], counts, ovf
+
+    fn = shard_map(local_cycle, mesh=dmesh,
+                   in_specs=(spec, spec, P()),
+                   out_specs=(spec, spec, P(), P()),
+                   check_rep=False)
+    return jax.jit(fn)
+
+
+def dist_quality(dmesh: DeviceMesh):
+    """Global quality histogram across shards (PMMG_qualhisto analogue,
+    quality_pmmg.c:156 — the custom MPI_Op reduction becomes psum/pmin)."""
+    spec = P("shard")
+
+    def local(mesh_s: Mesh, met_s):
+        mesh = _unstack(mesh_s)
+        met = met_s[0]
+        q = tet_quality(mesh, met)
+        counts, qmin, qmean, nbad = quality_histogram(q, mesh.tmask)
+        n = jnp.sum(mesh.tmask.astype(jnp.int32))
+        counts = jax.lax.psum(counts, "shard")
+        qmin = jax.lax.pmin(qmin, "shard")
+        qsum = jax.lax.psum(qmean * n, "shard")
+        ntot = jax.lax.psum(n, "shard")
+        nbad = jax.lax.psum(nbad, "shard")
+        return counts, qmin, qsum / jnp.maximum(ntot, 1), nbad, ntot
+
+    fn = shard_map(local, mesh=dmesh, in_specs=(spec, spec),
+                   out_specs=(P(), P(), P(), P(), P()), check_rep=False)
+    return jax.jit(fn)
+
+
+def distributed_adapt(mesh: Mesh, met, n_shards: int,
+                      cycles: int = 10, dmesh: DeviceMesh | None = None,
+                      partitioner: str = "morton", verbose: int = 0):
+    """One outer remesh pass on n_shards devices (host driver).
+
+    partition -> freeze interfaces -> SPMD adapt cycles -> merge.
+    The iterate-with-interface-displacement outer loop lives in
+    api/driver (PMMG_parmmglib1 analogue).
+    """
+    from ..core.mesh import tet_volumes, mesh_to_host
+    from .partition import morton_partition, greedy_partition, fix_contiguity
+    from .distribute import split_to_shards, merge_shards
+
+    if dmesh is None:
+        dmesh = make_device_mesh(n_shards)
+
+    vert, tet, vref, tref, vtag = mesh_to_host(mesh)
+    cent = vert[tet].mean(axis=1)
+    if partitioner == "morton":
+        part = morton_partition(cent, n_shards)
+    else:
+        part = greedy_partition(tet, cent, n_shards)
+    part = fix_contiguity(tet, part)
+
+    stacked, met_s = split_to_shards(mesh, met, part, n_shards)
+    stacked = shard_stacked(stacked, dmesh)
+    met_s = shard_stacked(met_s, dmesh)
+
+    step = dist_adapt_cycle(dmesh)
+    for c in range(cycles):
+        stacked, met_s, counts, ovf = step(stacked, met_s,
+                                           jnp.asarray(c, jnp.int32))
+        cs = np.asarray(counts)
+        if verbose >= 3:
+            print(f"  dist cycle {c}: split {cs[0]} collapse {cs[1]} "
+                  f"swap {cs[2]} move {cs[3]}")
+        if int(ovf) != 0:
+            raise MemoryError("shard capacity overflow — raise cap_mult")
+        if cs[0] == 0 and cs[1] == 0 and cs[2] == 0:
+            break
+    merged, met_m = merge_shards(stacked, met_s)
+    return merged, met_m, part

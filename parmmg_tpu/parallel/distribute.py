@@ -1,0 +1,190 @@
+"""Distribute a mesh into shards and merge shards back (host orchestration).
+
+Reference analogues: ``PMMG_distribute_mesh`` (distributemesh_pmmg.c:1109)
+splits the rank-0 mesh along a partition and sends each piece to its rank;
+``PMMG_merge_parmesh`` (mergemesh_pmmg.c:1571) gathers everything back and
+dedups interface entities through the node communicators.  Here shards are
+slots of a stacked pytree (leading device axis) and interface vertices are
+deduplicated at merge time by *exact* coordinate match — sound because
+parallel-interface points are frozen (``MG_PARBDY | MG_REQ``; reference
+tag contract tag_pmmg.c:39-124) and thus bit-identical on all shards.
+
+The interface tagging applied here IS the freeze contract: interface faces
+get MG_PARBDY|MG_BDY|MG_REQ|MG_NOSURF, their edges and vertices likewise
+(+ MG_PARBDYBDY on entities that are also true boundary), so the shard-local
+adapt operator (ops/adapt.py) leaves the interface untouched.
+"""
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.mesh import Mesh, make_mesh, mesh_to_host
+from ..core.constants import (
+    IDIR, FACE_EDGES, IARE, MG_BDY, MG_PARBDY, MG_PARBDYBDY, MG_REQ,
+    MG_NOSURF, PARBDY_TAGS)
+from ..ops.adjacency import build_adjacency, boundary_edge_tags
+
+
+def split_to_shards(mesh: Mesh, met, part: np.ndarray, nparts: int,
+                    cap_mult: float = 3.0):
+    """Split a host-resident Mesh into ``nparts`` shard Meshes (stacked).
+
+    Returns (shards: Mesh with leading axis [nparts, ...], met stacked,
+    None).  All shards share one capacity (max over shards * cap_mult /
+    nparts-balance) so they stack into one pytree for shard_map.
+    """
+    vert, tet, vref, tref, vtag = mesh_to_host(mesh)
+    methost = np.asarray(met)
+    vm = np.asarray(mesh.vmask)
+    new_id = np.cumsum(vm) - 1
+    methost = methost[vm]
+    part = np.asarray(part, np.int32)
+    assert part.shape[0] == len(tet)
+
+    # interface faces: faces shared by tets of different parts
+    n = len(tet)
+    faces = np.sort(tet[:, IDIR].reshape(n * 4, 3), axis=1)
+    key = (faces[:, 0].astype(np.int64) << 42) | \
+          (faces[:, 1].astype(np.int64) << 21) | faces[:, 2].astype(np.int64)
+    order = np.argsort(key, kind="stable")
+    ks = key[order]
+    same = ks[1:] == ks[:-1]
+    fA = order[:-1][same]
+    fB = order[1:][same]
+    cross = part[fA // 4] != part[fB // 4]
+    ifc_faces = np.concatenate([fA[cross], fB[cross]])   # global face slots
+
+    # mark interface vertices
+    ifc_vert = np.zeros(len(vert), bool)
+    ifc_vert[faces[ifc_faces].reshape(-1)] = True
+
+    shards_m = []
+    shards_met = []
+    maxP = maxT = 0
+    locals_ = []
+    for p in range(nparts):
+        sel = part == p
+        ltet_g = tet[sel]
+        used = np.zeros(len(vert), bool)
+        used[ltet_g.reshape(-1)] = True
+        g2l = np.full(len(vert), -1, np.int64)
+        gids = np.where(used)[0]
+        g2l[gids] = np.arange(len(gids))
+        locals_.append((gids, ltet_g, np.where(sel)[0]))
+        maxP = max(maxP, len(gids))
+        maxT = max(maxT, len(ltet_g))
+
+    capP = max(64, int(cap_mult * maxP))
+    capT = max(64, int(cap_mult * maxT))
+
+    face_is_ifc = np.zeros(n * 4, bool)
+    face_is_ifc[ifc_faces] = True
+    face_is_ifc = face_is_ifc.reshape(n, 4)
+
+    for p in range(nparts):
+        gids, ltet_g, tsel = locals_[p]
+        g2l = np.full(len(vert), -1, np.int64)
+        g2l[gids] = np.arange(len(gids))
+        lvert = vert[gids]
+        ltet = g2l[ltet_g].astype(np.int32)
+        sm = make_mesh(lvert, ltet, vref=vref[gids], tref=tref[tsel],
+                       capP=capP, capT=capT, dtype=mesh.dtype)
+        # carry original tags
+        svtag = np.zeros(capP, np.uint32)
+        svtag[: len(gids)] = vtag[gids]
+        # freeze interface: vertices
+        on_ifc = ifc_vert[gids]
+        svtag[: len(gids)][on_ifc] |= PARBDY_TAGS
+        # PARBDYBDY: interface vertex that is also true boundary
+        true_bdy = (vtag[gids] & MG_BDY) != 0
+        svtag[: len(gids)][on_ifc & true_bdy] |= MG_PARBDYBDY
+        # faces + edges of interface
+        sftag = np.zeros((capT, 4), np.uint32)
+        setag = np.zeros((capT, 6), np.uint32)
+        lf_ifc = face_is_ifc[tsel]                       # [nt,4]
+        sftag[: len(ltet)][lf_ifc] |= PARBDY_TAGS
+        for f in range(4):
+            for e in FACE_EDGES[f]:
+                setag[: len(ltet), e] |= np.where(
+                    lf_ifc[:, f], np.uint32(PARBDY_TAGS), np.uint32(0))
+        sm = dataclasses.replace(
+            sm, vtag=jnp.asarray(svtag),
+            ftag=jnp.maximum(sm.ftag, jnp.asarray(sftag)),
+            etag=jnp.maximum(sm.etag, jnp.asarray(setag)))
+        sm = boundary_edge_tags(build_adjacency(sm))
+        shards_m.append(sm)
+        lmet = np.zeros((capP,) + methost.shape[1:], methost.dtype)
+        lmet[: len(gids)] = methost[gids]
+        shards_met.append(jnp.asarray(lmet))
+
+    stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *shards_m)
+    met_stacked = jnp.stack(shards_met)
+    return stacked, met_stacked
+
+
+def merge_shards(shards: Mesh, mets=None):
+    """Merge stacked shard Meshes back into one host Mesh (+ metric).
+
+    Interface vertices are deduplicated by exact coordinate bytes — valid
+    because MG_PARBDY points are frozen during shard-local adaptation.
+    """
+    nsh = shards.vert.shape[0]
+    all_v, all_tag, all_ref, all_met = [], [], [], []
+    all_t, all_tref = [], []
+    offsets = []
+    off = 0
+    for s in range(nsh):
+        one = jax.tree.map(lambda x: x[s], shards)
+        vert, tet, vref, tref, vtag = mesh_to_host(one)
+        all_v.append(vert)
+        all_tag.append(vtag)
+        all_ref.append(vref)
+        all_t.append(tet + off)
+        all_tref.append(tref)
+        if mets is not None:
+            mh = np.asarray(mets[s])[np.asarray(one.vmask)]
+            all_met.append(mh)
+        offsets.append(off)
+        off += len(vert)
+    vert = np.concatenate(all_v)
+    vtag = np.concatenate(all_tag)
+    vref = np.concatenate(all_ref)
+    tet = np.concatenate(all_t)
+    tref = np.concatenate(all_tref)
+
+    # dedup PARBDY vertices by coordinate bytes
+    is_ifc = (vtag & MG_PARBDY) != 0
+    keys = vert.astype(np.float64).tobytes()
+    rows = np.frombuffer(keys, dtype=np.dtype((np.void, 24)))
+    uniq, first_idx, inv = np.unique(rows, return_index=True,
+                                     return_inverse=True)
+    # canonical id: first occurrence; only merge interface copies
+    canon = first_idx[inv]
+    remap = np.arange(len(vert))
+    remap[is_ifc] = canon[is_ifc]
+    # drop PARBDY tags after merge (interfaces no longer exist) but keep
+    # true-boundary info via MG_PARBDYBDY
+    keep = np.zeros(len(vert), bool)
+    keep[remap] = True
+    new_id = np.cumsum(keep) - 1
+    tet = new_id[remap[tet]].astype(np.int32)
+    vtag2 = vtag[keep].copy()
+    was_truebdy = (vtag2 & MG_PARBDYBDY) != 0
+    was_parbdy = (vtag2 & MG_PARBDY) != 0
+    vtag2 &= ~np.uint32(PARBDY_TAGS | MG_PARBDYBDY)
+    vtag2[was_truebdy] |= MG_BDY
+    vtag2[was_parbdy & ~was_truebdy] &= ~np.uint32(MG_BDY)
+    m = make_mesh(vert[keep], tet, vref=vref[keep], tref=tref)
+    m = dataclasses.replace(m, vtag=jnp.asarray(vtag2.astype(np.uint32)))
+    m = boundary_edge_tags(build_adjacency(m))
+    out_met = None
+    if mets is not None:
+        met = np.concatenate(all_met)[keep]
+        full = np.zeros((m.capP,) + met.shape[1:], met.dtype)
+        full[: len(met)] = met
+        out_met = jnp.asarray(full)
+    return m, out_met

@@ -1,0 +1,83 @@
+"""Synthetic mesh generators for tests and benchmarks.
+
+The reference test suite pulls Cube/Sphere/Torus meshes from a separate data
+repo (cmake/testing/pmmg_tests.cmake:12-23); we generate equivalents
+procedurally so the test matrix is self-contained.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+# Each unit cube cell is split into 6 tets (Kuhn/Freudenthal triangulation:
+# all tets share the main diagonal (0,0,0)-(1,1,1); produces a conforming
+# mesh across cells without parity flips).
+_KUHN_TETS = np.array([
+    [0, 1, 3, 7],
+    [0, 1, 5, 7],
+    [0, 2, 3, 7],
+    [0, 2, 6, 7],
+    [0, 4, 5, 7],
+    [0, 4, 6, 7],
+], dtype=np.int64)
+# corner i of the cell has offsets (i&1, (i>>1)&1, (i>>2)&1)
+
+
+def cube_mesh(n: int = 4):
+    """Structured [0,1]^3 cube: (n+1)^3 vertices, 6*n^3 tets.
+
+    Returns (vert [np,3] float64, tet [ne,4] int32), positively oriented.
+    """
+    k = n + 1
+    g = np.arange(k) / n
+    X, Y, Z = np.meshgrid(g, g, g, indexing="ij")
+    vert = np.stack([X.ravel(), Y.ravel(), Z.ravel()], axis=1)
+
+    def vid(i, j, l):
+        return (i * k + j) * k + l
+
+    ii, jj, ll = np.meshgrid(np.arange(n), np.arange(n), np.arange(n),
+                             indexing="ij")
+    base = np.stack([ii.ravel(), jj.ravel(), ll.ravel()], 1)  # [n^3,3]
+    corners = np.empty((base.shape[0], 8), np.int64)
+    for c in range(8):
+        off = np.array([c & 1, (c >> 1) & 1, (c >> 2) & 1])
+        q = base + off
+        corners[:, c] = vid(q[:, 0], q[:, 1], q[:, 2])
+    tet = corners[:, _KUHN_TETS].reshape(-1, 4)
+    tet = _orient_positive(vert, tet)
+    return vert, tet.astype(np.int32)
+
+
+def sphere_mesh(n: int = 8):
+    """Unit ball: cube mesh mapped radially onto the ball (graded)."""
+    vert, tet = cube_mesh(n)
+    c = vert * 2.0 - 1.0                       # [-1,1]^3
+    linf = np.max(np.abs(c), axis=1)
+    l2 = np.linalg.norm(c, axis=1)
+    scale = np.where(l2 > 1e-12, linf / np.maximum(l2, 1e-12), 1.0)
+    vert = c * scale[:, None]
+    tet = _orient_positive(vert, tet)
+    return vert, tet.astype(np.int32)
+
+
+def _orient_positive(vert, tet):
+    p = vert[tet]
+    det = np.einsum("ti,ti->t", p[:, 1] - p[:, 0],
+                    np.cross(p[:, 2] - p[:, 0], p[:, 3] - p[:, 0]))
+    flip = det < 0
+    tet = tet.copy()
+    tet[flip, 0], tet[flip, 1] = tet[flip, 1], tet[flip, 0].copy()
+    return tet
+
+
+def analytic_iso_metric(vert: np.ndarray, kind: str = "uniform",
+                        h: float = 0.1):
+    """Test metrics: uniform h, or a planar 'shock' refinement band."""
+    if kind == "uniform":
+        return np.full(vert.shape[0], h)
+    if kind == "shock":
+        # small size near the plane x=0.5, large away (aniso-torus analogue
+        # of the reference CI matrix)
+        d = np.abs(vert[:, 0] - 0.5)
+        return h * (0.2 + 4.0 * d)
+    raise ValueError(kind)

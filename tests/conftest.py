@@ -1,0 +1,19 @@
+"""Test config: force an 8-device virtual CPU mesh before JAX import.
+
+Mirrors the reference CI matrix over MPI rank counts {1,2,4,6,8}
+(cmake/testing/pmmg_tests.cmake:30-63) — here rank = virtual CPU device.
+JAX_PLATFORMS is force-overridden: the environment presets the real TPU
+(axon), but unit tests must not serialize on the single chip.
+"""
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+# persistent compile cache: the wave kernels are large XLA graphs; caching
+# across pytest processes cuts minutes per run
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/root/repo/.jax_cache")
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")

@@ -1,0 +1,101 @@
+"""M2 tests: metric synthesis/gradation and background interpolation."""
+import numpy as np
+import jax.numpy as jnp
+
+from parmmg_tpu.core.mesh import make_mesh
+from parmmg_tpu.ops.adjacency import build_adjacency, boundary_edge_tags
+from parmmg_tpu.ops.metric import (
+    metric_hsiz, metric_optim, clamp_metric, gradation)
+from parmmg_tpu.ops.interp import (
+    locate_points, interp_p1, interp_metric_ani, LocateResult,
+    interpolate_from_background)
+from parmmg_tpu.ops.quality import iso_to_tensor
+from parmmg_tpu.utils.fixtures import cube_mesh
+
+
+def _cube(n=3, capmul=2):
+    vert, tet = cube_mesh(n)
+    m = make_mesh(vert, tet, capP=capmul * len(vert), capT=capmul * len(tet))
+    return boundary_edge_tags(build_adjacency(m))
+
+
+def test_metric_optim_matches_grid():
+    m = _cube(4)
+    h = np.asarray(metric_optim(m))[np.asarray(m.vmask)]
+    # mean incident edge length of a kuhn grid with spacing 0.25:
+    # mix of 0.25, 0.25*sqrt2, 0.25*sqrt3 -> between 0.25 and 0.44
+    assert (h > 0.24).all() and (h < 0.45).all()
+
+
+def test_clamp_metric_iso_and_ani():
+    met = jnp.array([0.01, 0.5, 10.0])
+    c = clamp_metric(met, 0.1, 1.0)
+    assert np.allclose(np.asarray(c), [0.1, 0.5, 1.0])
+    ani = iso_to_tensor(met)
+    ca = np.asarray(clamp_metric(ani, 0.1, 1.0))
+    # eigenvalues must be within [1, 100]
+    assert np.allclose(ca[0, [0, 3, 5]], 100.0)
+    assert np.allclose(ca[2, [0, 3, 5]], 1.0)
+
+
+def test_gradation_limits_growth():
+    m = _cube(4)
+    met = np.full(m.capP, 1.0)
+    # one tiny vertex size in the middle
+    vert = np.asarray(m.vert)
+    mid = np.argmin(np.abs(vert - 0.5).sum(axis=1))
+    met[mid] = 0.01
+    g = gradation(m, jnp.asarray(met), hgrad=1.3)
+    g = np.asarray(g)
+    # neighbors one grid step away (0.25) may be at most 0.01+0.3*dist
+    d = np.linalg.norm(vert - vert[mid], axis=1)
+    vm = np.asarray(m.vmask)
+    bound = 0.01 + 0.3 * d + 1e-5
+    assert (g[vm] <= bound[vm] + 1e-6).all()
+    assert g[mid] == 0.01
+
+
+def test_locate_points_walk():
+    m = _cube(3)
+    rng = np.random.default_rng(1)
+    pts = rng.uniform(0.05, 0.95, (50, 3)).astype(np.float32)
+    loc = locate_points(m, jnp.asarray(pts), jnp.zeros(50, jnp.int32))
+    assert not np.asarray(loc.failed).any()
+    # verify containment: all barycoords >= -1e-3
+    assert float(jnp.min(loc.bary)) > -1e-3
+    tids = np.asarray(loc.tet)
+    assert (np.asarray(m.tmask)[tids]).all()
+
+
+def test_interp_p1_linear_exact():
+    m = _cube(3)
+    # a linear field is reproduced exactly by P1 interpolation
+    coef = np.array([1.5, -2.0, 0.5])
+    vals = np.asarray(m.vert) @ coef + 0.25
+    rng = np.random.default_rng(2)
+    pts = rng.uniform(0.1, 0.9, (40, 3)).astype(np.float32)
+    loc = locate_points(m, jnp.asarray(pts), jnp.zeros(40, jnp.int32))
+    got = np.asarray(interp_p1(jnp.asarray(vals), m.tet, loc))
+    want = pts @ coef + 0.25
+    assert np.allclose(got, want, atol=1e-4)
+
+
+def test_interp_ani_constant_exact():
+    m = _cube(2)
+    t = np.tile(np.array([4.0, 0.5, 0.0, 9.0, 0.1, 1.0]), (m.capP, 1))
+    pts = np.array([[0.3, 0.3, 0.3], [0.7, 0.2, 0.5]], np.float32)
+    loc = locate_points(m, jnp.asarray(pts), jnp.zeros(2, jnp.int32))
+    got = np.asarray(interp_metric_ani(jnp.asarray(t), m.tet, loc))
+    assert np.allclose(got, t[:2], atol=1e-4)
+
+
+def test_interpolate_from_background_driver():
+    bg = _cube(3)
+    bg_met = jnp.asarray(np.linspace(0.1, 0.5, bg.capP))
+    mesh = _cube(2)
+    met = jnp.full(mesh.capP, 99.0)
+    met2, _, loc = interpolate_from_background(bg, bg_met, mesh, met)
+    met2 = np.asarray(met2)
+    vm = np.asarray(mesh.vmask)
+    assert (met2[vm] < 1.0).all()          # overwritten from background
+    assert not np.asarray(loc.failed)[vm].any()

@@ -1,0 +1,167 @@
+"""M1 tests: collapse, swap, smooth waves and the full adapt driver."""
+import dataclasses
+
+import numpy as np
+import jax.numpy as jnp
+
+from parmmg_tpu.core.mesh import make_mesh, tet_volumes
+from parmmg_tpu.core import constants as C
+from parmmg_tpu.ops.adjacency import (
+    build_adjacency, check_adjacency, boundary_edge_tags)
+from parmmg_tpu.ops.collapse import collapse_wave
+from parmmg_tpu.ops.swap import swap23_wave, swap32_wave
+from parmmg_tpu.ops.smooth import smooth_wave
+from parmmg_tpu.ops.adapt import adapt_mesh
+from parmmg_tpu.ops.quality import tet_quality
+from parmmg_tpu.ops.edges import unique_edges, edge_lengths
+from parmmg_tpu.utils.fixtures import cube_mesh
+
+
+def _cube(n=2, capmul=4):
+    vert, tet = cube_mesh(n)
+    m = make_mesh(vert, tet, capP=capmul * len(vert), capT=capmul * len(tet))
+    return boundary_edge_tags(build_adjacency(m))
+
+
+def _check_valid(m, vol_target=1.0):
+    m = build_adjacency(m)
+    assert check_adjacency(m) == {"asymmetric": 0, "face_mismatch": 0}
+    vols = np.asarray(tet_volumes(m))[np.asarray(m.tmask)]
+    assert (vols > 0).all()
+    assert np.isclose(vols.sum(), vol_target, rtol=1e-4)
+    return m
+
+
+def test_collapse_coarsens():
+    m = _cube(3)
+    npoin0, nelem0 = m.np_counts()
+    met = jnp.full(m.capP, 2.0)          # everything is "too short"
+    total = 0
+    for _ in range(10):
+        res = collapse_wave(m, met)
+        m = build_adjacency(res.mesh)
+        n = int(res.ncollapse)
+        total += n
+        if n == 0:
+            break
+    assert total > 0
+    npoin1, nelem1 = m.np_counts()
+    assert npoin1 < npoin0
+    assert nelem1 < nelem0
+    _check_valid(m)
+
+
+def test_collapse_keeps_corners():
+    m = _cube(2)
+    met = jnp.full(m.capP, 5.0)
+    for _ in range(12):
+        res = collapse_wave(m, met)
+        m = build_adjacency(res.mesh)
+        if int(res.ncollapse) == 0:
+            break
+    m = _check_valid(m)
+    # the 8 cube corners can never be removed (they are surface extreme
+    # points; interior collapse of them would pull in the boundary)
+    vert = np.asarray(m.vert)[np.asarray(m.vmask)]
+    for corner in [(0, 0, 0), (1, 1, 1), (0, 1, 0), (1, 0, 1)]:
+        d = np.abs(vert - np.array(corner)).sum(axis=1).min()
+        assert d < 1e-6, f"corner {corner} was collapsed away"
+
+
+def test_smooth_improves_quality():
+    m = _cube(3)
+    # jitter interior points to damage quality
+    rng = np.random.default_rng(0)
+    vert = np.asarray(m.vert).copy()
+    vm = np.asarray(m.vmask)
+    interior = vm & ~(((vert == 0) | (vert == 1)).any(axis=1))
+    vert[interior] += rng.uniform(-0.08, 0.08, (interior.sum(), 3))
+    m = dataclasses.replace(m, vert=jnp.asarray(vert))
+    met = jnp.full(m.capP, 1 / 3)
+    q0 = float(jnp.min(jnp.where(m.tmask, tet_quality(m), jnp.inf)))
+    moved = 0
+    for w in range(6):
+        res = smooth_wave(m, met, wave=w)
+        m = res.mesh
+        moved += int(res.nmoved)
+    q1 = float(jnp.min(jnp.where(m.tmask, tet_quality(m), jnp.inf)))
+    assert moved > 0
+    assert q1 > q0
+    _check_valid(m)
+
+
+def test_swap_on_bad_config():
+    # two tets sharing a face, nearly degenerate, where 2-3 swap helps:
+    # thin "roof" pair
+    vert = np.array([
+        [0, 0, 0], [1, 0, 0], [0.5, 1, 0.05],   # shared face, nearly flat
+        [0.5, 0.4, -0.6], [0.5, 0.4, 0.7],
+    ])
+    tet = np.array([[0, 1, 2, 4], [1, 0, 2, 3]], np.int32)
+    m = make_mesh(vert, tet, capP=32, capT=32)
+    m = build_adjacency(m)
+    met = jnp.full(m.capP, 0.8)
+    q0 = float(jnp.min(jnp.where(m.tmask, tet_quality(m), jnp.inf)))
+    res = swap23_wave(m, met)
+    if int(res.nswap):
+        m2 = build_adjacency(res.mesh)
+        assert check_adjacency(m2) == {"asymmetric": 0, "face_mismatch": 0}
+        vols0 = np.asarray(tet_volumes(m))[np.asarray(m.tmask)].sum()
+        vols1 = np.asarray(tet_volumes(m2))[np.asarray(m2.tmask)]
+        assert (vols1 > 0).all()
+        assert np.isclose(vols1.sum(), vols0, rtol=1e-5)
+        q1 = float(jnp.min(jnp.where(m2.tmask, tet_quality(m2), jnp.inf)))
+        assert q1 > q0
+
+
+def test_swap32_reduces_shell():
+    # 3 tets around an interior edge (a,b), ring p,q,r
+    a, b = [0.5, 0.5, -1.0], [0.5, 0.5, 1.0]
+    p, q, r = [0, 0, 0], [1, 0, 0], [0.5, 1.2, 0]
+    vert = np.array([a, b, p, q, r])
+    # shell tets: (a,b) edge with ring pairs (p,q),(q,r),(r,p)
+    tet = np.array([[0, 1, 2, 3], [0, 1, 3, 4], [0, 1, 4, 2]], np.int32)
+    # fix orientation
+    from parmmg_tpu.utils.fixtures import _orient_positive
+    tet = _orient_positive(vert, tet)
+    m = make_mesh(vert, tet, capP=32, capT=32)
+    m = build_adjacency(m)
+    met = jnp.full(m.capP, 1.0)
+    res = swap32_wave(m, met)
+    # the ring triangle is large relative to the edge: swap should trigger
+    if int(res.nswap):
+        m2 = build_adjacency(res.mesh)
+        assert m2.np_counts()[1] == 2
+        assert check_adjacency(m2) == {"asymmetric": 0, "face_mismatch": 0}
+        vols0 = np.asarray(tet_volumes(m))[np.asarray(m.tmask)].sum()
+        vols1 = np.asarray(tet_volumes(m2))[np.asarray(m2.tmask)]
+        assert (vols1 > 0).all()
+        assert np.isclose(vols1.sum(), vols0, rtol=1e-5)
+
+
+def test_adapt_refine_and_coarsen_roundtrip():
+    m = _cube(2)
+    met = jnp.full(m.capP, 0.2)
+    m, met, st = adapt_mesh(m, met, max_cycles=20)
+    assert st.nsplit > 0
+    m = _check_valid(m)
+    n_ref = m.np_counts()
+    # now coarsen back
+    met2 = jnp.where(m.vmask, 0.9, met)
+    m2, met2, st2 = adapt_mesh(m, met2, max_cycles=20)
+    assert st2.ncollapse > 0
+    m2 = _check_valid(m2)
+    assert m2.np_counts()[0] < n_ref[0]
+
+
+def test_adapt_target_lengths():
+    m = _cube(2)
+    met = jnp.full(m.capP, 0.23)
+    m, met, st = adapt_mesh(m, met, max_cycles=25)
+    m = _check_valid(m)
+    et = unique_edges(m)
+    lens = np.asarray(edge_lengths(m, et, met))[np.asarray(et.emask)]
+    # no edge above the split threshold; most edges in the good range
+    assert lens.max() < C.LLONG + 1e-4
+    q = np.asarray(tet_quality(m))[np.asarray(m.tmask)]
+    assert q.min() > 0.1

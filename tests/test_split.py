@@ -1,0 +1,123 @@
+"""M1 tests: edge table + batched split waves."""
+import numpy as np
+import jax.numpy as jnp
+
+from parmmg_tpu.core.mesh import make_mesh, tet_volumes, mesh_to_host
+from parmmg_tpu.core import constants as C
+from parmmg_tpu.ops.adjacency import build_adjacency, check_adjacency, \
+    boundary_edge_tags
+from parmmg_tpu.ops.edges import unique_edges, edge_lengths
+from parmmg_tpu.ops.split import split_wave
+from parmmg_tpu.ops.quality import tet_quality
+from parmmg_tpu.utils.fixtures import cube_mesh
+
+
+def _cube(n=2, capmul=8):
+    vert, tet = cube_mesh(n)
+    m = make_mesh(vert, tet, capP=capmul * len(vert), capT=capmul * len(tet))
+    return boundary_edge_tags(build_adjacency(m))
+
+
+def test_unique_edges_cube():
+    m = _cube(2)
+    et = unique_edges(m)
+    # kuhn cube n=2: vertices 27; edges: 3*n*(n+1)^2 axis + face diags
+    # count unique edges by brute force
+    ev = np.asarray(et.ev)[np.asarray(et.emask)]
+    tets = np.asarray(m.tet)[np.asarray(m.tmask)]
+    ref = set()
+    for t in tets:
+        for a, b in [(0, 1), (0, 2), (0, 3), (1, 2), (1, 3), (2, 3)]:
+            ref.add((min(t[a], t[b]), max(t[a], t[b])))
+    got = set(map(tuple, ev))
+    assert got == ref
+    # shell sizes sum to 6 * ntet
+    assert int(np.asarray(et.nshell)[np.asarray(et.emask)].sum()) == 6 * len(tets)
+
+
+def test_split_wave_conforming():
+    m = _cube(2)
+    met = jnp.full(m.capP, 0.4)  # grid h=0.5 > 0.4*sqrt2? 0.5/0.4=1.25<1.41
+    # choose met so the longest edges (body diag sqrt(3)/2=0.866) split:
+    # 0.866/0.4 = 2.17 > 1.414 -> candidates
+    res = split_wave(m, met)
+    assert int(res.nsplit) > 0
+    assert not bool(res.overflow)
+    m2 = build_adjacency(res.mesh)
+    assert check_adjacency(m2) == {"asymmetric": 0, "face_mismatch": 0}
+    vols = np.asarray(tet_volumes(m2))
+    tm = np.asarray(m2.tmask)
+    assert (vols[tm] > 0).all()
+    # volume conserved
+    assert np.isclose(vols[tm].sum(), 1.0, atol=1e-5)
+
+
+def test_split_until_converged():
+    m = _cube(2)
+    met0 = jnp.full(m.capP, 0.30)
+    met = met0
+    total = 0
+    for wave in range(12):
+        res = split_wave(m, met)
+        m, met = res.mesh, res.met
+        ns = int(res.nsplit)
+        total += ns
+        assert not bool(res.overflow)
+        if ns == 0:
+            break
+    assert ns == 0, "did not converge"
+    assert total > 10
+    m = build_adjacency(m)
+    assert check_adjacency(m) == {"asymmetric": 0, "face_mismatch": 0}
+    vols = np.asarray(tet_volumes(m))[np.asarray(m.tmask)]
+    assert (vols > 0).all()
+    assert np.isclose(vols.sum(), 1.0, atol=1e-5)
+    # all edges now below the split threshold
+    et = unique_edges(m)
+    lens = np.asarray(edge_lengths(m, et, met))[np.asarray(et.emask)]
+    assert lens.max() <= C.LLONG + 1e-5
+    # no degenerate quality
+    q = np.asarray(tet_quality(m))[np.asarray(m.tmask)]
+    assert q.min() > 0.05
+
+
+def test_split_preserves_boundary_tags():
+    m = _cube(2)
+    met = jnp.full(m.capP, 0.25)
+    for _ in range(10):
+        res = split_wave(m, met)
+        m, met = res.mesh, res.met
+        if int(res.nsplit) == 0:
+            break
+    # every vertex on the unit-cube surface must be tagged MG_BDY, interior
+    # vertices must not
+    vert, tet, vref, tref, vtag = mesh_to_host(m)
+    on_bdy = ((np.abs(vert) < 1e-6) | (np.abs(vert - 1) < 1e-6)).any(axis=1)
+    has_tag = (vtag & C.MG_BDY) != 0
+    assert (has_tag == on_bdy).all()
+
+
+def test_split_respects_frozen_edges():
+    m = _cube(2)
+    # freeze everything: tag all edges REQ
+    import dataclasses
+    m = dataclasses.replace(
+        m, etag=jnp.where(jnp.ones_like(m.etag, dtype=bool),
+                          m.etag | C.MG_REQ, m.etag))
+    met = jnp.full(m.capP, 0.1)
+    res = split_wave(m, met)
+    assert int(res.nsplit) == 0
+
+
+def test_split_overflow_guard():
+    vert, tet = cube_mesh(2)
+    m = make_mesh(vert, tet, capP=len(vert) + 2, capT=len(tet) + 4)
+    m = boundary_edge_tags(build_adjacency(m))
+    met = jnp.full(m.capP, 0.05)
+    res = split_wave(m, met)
+    # must not crash; at most 2 points inserted
+    assert int(res.nsplit) <= 2
+    m2 = build_adjacency(res.mesh)
+    assert check_adjacency(m2) == {"asymmetric": 0, "face_mismatch": 0}
+    vols = np.asarray(tet_volumes(m2))[np.asarray(m2.tmask)]
+    assert (vols > 0).all()
