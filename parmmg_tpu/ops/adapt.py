@@ -102,7 +102,8 @@ def adapt_mesh(mesh: Mesh, met: jax.Array, max_cycles: int = 50,
     Returns (mesh, met, AdaptStats).
     """
     stats = AdaptStats()
-    mesh = build_adjacency(mesh)
+    from .analysis import analyze_mesh
+    mesh = analyze_mesh(mesh).mesh
     quiet = 0
     for cycle in range(max_cycles):
         # capacity management before the wave

@@ -1,0 +1,167 @@
+"""Surface analysis: boundary extraction, ridges, corners, normals.
+
+TPU-native equivalent of the sequential analysis the reference delegates to
+Mmg (``MMG3D_analys``: ``setadj``/``setdhd``/``singul``/``norver``; invoked
+at /root/reference/src/libparmmg.c:128-204 before adaptation) and whose
+parallel supplement lives in analys_pmmg.c.  The semantics reproduced here:
+
+- boundary faces are tet faces without a neighbor (``build_adjacency``);
+- an edge shared by two boundary faces whose normals make a dihedral angle
+  sharper than ``angedg`` (default 45 deg) is a *ridge* (``MG_GEO``) —
+  Mmg's ``setdhd``;
+- an edge whose two boundary faces carry different surface references is a
+  *reference edge* (``MG_REF``);
+- an edge with a number of incident boundary faces other than 2 is
+  *non-manifold* (``MG_NOM``, e.g. open boundaries);
+- a boundary vertex with exactly 2 incident ridge edges is a ridge point
+  (``MG_GEO``); with 1 or >2 it is a *corner* (``MG_CRN``) — Mmg's
+  ``singul`` rules;
+- vertex normals are area-weighted averages of incident boundary-face
+  normals (Mmg's ``norver``; the two-normal ridge bookkeeping is carried by
+  the per-face normals, recomputed on demand).
+
+Everything is sort/segment based (no hash tables): boundary face-edge
+records are matched through the unique-edge table of ``ops.edges``.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from ..core.mesh import Mesh, tet_face_vertices
+from ..core.constants import (
+    ANGEDG, FACE_EDGES, IDIR, MG_BDY, MG_CRN, MG_GEO, MG_NOM, MG_REF)
+from .adjacency import build_adjacency
+from .edges import unique_edges
+
+_IDIR_J = jnp.asarray(IDIR)
+_FACE_EDGES_J = jnp.asarray(FACE_EDGES)
+
+
+class AnalysisResult(NamedTuple):
+    mesh: Mesh
+    vnormal: jax.Array    # [capP, 3] unit vertex normals (0 off-surface)
+
+
+def face_normals(mesh: Mesh) -> jax.Array:
+    """[capT, 4, 3] outward (non-unit) normals of each tet face.
+
+    With the IDIR convention and positively oriented tets, the cross
+    product of the two face edge vectors points outward.
+    """
+    fv = tet_face_vertices(mesh.tet)               # [T,4,3] vertex ids
+    p = mesh.vert[fv]                              # [T,4,3,3]
+    return jnp.cross(p[:, :, 1] - p[:, :, 0], p[:, :, 2] - p[:, :, 0])
+
+
+def analyze_mesh(mesh: Mesh, angedg: float = ANGEDG) -> AnalysisResult:
+    """Run the full sequential surface analysis; jittable.
+
+    Expects/It (re)builds adjacency, then derives all geometric entity tags
+    from scratch (existing REQ/PARBDY bits are preserved).
+    """
+    mesh = build_adjacency(mesh)
+    capT, capP = mesh.capT, mesh.capP
+    et = unique_edges(mesh)
+    capE = et.ev.shape[0]
+
+    is_bdy_face = ((mesh.ftag & MG_BDY) != 0) & mesh.tmask[:, None]  # [T,4]
+    nrm = face_normals(mesh)                                          # [T,4,3]
+    nrm_unit = nrm / jnp.maximum(
+        jnp.linalg.norm(nrm, axis=-1, keepdims=True), 1e-30)
+
+    # --- boundary face-edge records (12 per tet) -------------------------
+    # record r = (tet t, face f, edge j of face): eid via the edge table
+    le = _FACE_EDGES_J[None, :, :]                       # [1,4,3] local edge
+    le = jnp.broadcast_to(le, (capT, 4, 3))
+    eid = jnp.take_along_axis(
+        et.edge_id[:, None, :].repeat(4, axis=1), le, axis=2)   # [T,4,3]
+    rec_valid = is_bdy_face[:, :, None] & jnp.ones((1, 1, 3), bool)
+    R = capT * 12
+    eid_f = eid.reshape(R)
+    val_f = rec_valid.reshape(R)
+    nrm_f = jnp.broadcast_to(nrm_unit[:, :, None, :],
+                             (capT, 4, 3, 3)).reshape(R, 3)
+    fref_f = jnp.broadcast_to(mesh.fref[:, :, None],
+                              (capT, 4, 3)).reshape(R)
+
+    # --- sort records by eid, match neighbors in segments ----------------
+    key = jnp.where(val_f, eid_f, capE)
+    order = jnp.argsort(key)
+    ks = key[order]
+    n_s = nrm_f[order]
+    r_s = fref_f[order]
+    v_s = val_f[order]
+    eq_next = (ks[1:] == ks[:-1]) & (ks[:-1] < capE)
+    same_next = jnp.concatenate([eq_next, jnp.array([False])])
+    same_prev = jnp.concatenate([jnp.array([False]), eq_next])
+    idx = jnp.arange(R)
+    partner = jnp.where(same_next, idx + 1,
+                        jnp.where(same_prev, idx - 1, idx))
+    # per-record pair tests (meaningful only when the segment has size 2;
+    # larger segments are non-manifold and flagged by the count below)
+    dot = jnp.sum(n_s * n_s[partner], axis=-1)
+    ridge_r = v_s & (same_next | same_prev) & (dot < angedg)
+    refed_r = v_s & (same_next | same_prev) & (r_s != r_s[partner])
+
+    # segment sizes per eid (number of incident boundary faces)
+    cnt = jnp.zeros(capE + 1, jnp.int32).at[
+        jnp.where(val_f, eid_f, capE)].add(1, mode="drop")[:capE]
+    has_bdy = cnt > 0
+    nom_e = has_bdy & (cnt != 2)
+
+    # scatter pair flags to unique edges
+    ridge_e = jnp.zeros(capE + 1, bool).at[
+        jnp.where(v_s, ks, capE)].max(ridge_r, mode="drop")[:capE]
+    refed_e = jnp.zeros(capE + 1, bool).at[
+        jnp.where(v_s, ks, capE)].max(refed_r, mode="drop")[:capE]
+    ridge_e = ridge_e & ~nom_e      # non-manifold handled separately
+    bdy_e = has_bdy
+
+    # --- write edge tags back onto every tet-edge slot -------------------
+    add = (jnp.where(ridge_e, MG_GEO, 0) | jnp.where(refed_e, MG_REF, 0)
+           | jnp.where(nom_e, MG_NOM, 0)
+           | jnp.where(bdy_e, MG_BDY, 0)).astype(jnp.uint32)
+    etag = mesh.etag | jnp.where(mesh.tmask[:, None], add[et.edge_id],
+                                 jnp.uint32(0))
+
+    # --- vertex classification (singul) ----------------------------------
+    sing_e = ridge_e | refed_e | nom_e       # edges that make points special
+    nsing = jnp.zeros(capP + 1, jnp.int32)
+    vbdy = jnp.zeros(capP + 1, bool)
+    vnom = jnp.zeros(capP + 1, bool)
+    vref = jnp.zeros(capP + 1, bool)
+    for side in range(2):
+        tgt = jnp.where(et.emask, et.ev[:, side], capP)
+        nsing = nsing.at[tgt].add(sing_e.astype(jnp.int32), mode="drop")
+        vbdy = vbdy.at[tgt].max(bdy_e, mode="drop")
+        vnom = vnom.at[tgt].max(nom_e, mode="drop")
+        vref = vref.at[tgt].max(refed_e, mode="drop")
+    nsing, vbdy = nsing[:capP], vbdy[:capP]
+    vnom, vref = vnom[:capP], vref[:capP]
+
+    on_ridge = nsing == 2
+    corner = (nsing == 1) | (nsing > 2)
+    vadd = (jnp.where(vbdy, MG_BDY, 0)
+            | jnp.where(on_ridge, MG_GEO, 0)
+            | jnp.where(corner, MG_CRN, 0)
+            | jnp.where(vnom, MG_NOM, 0)
+            | jnp.where(vref, MG_REF, 0)).astype(jnp.uint32)
+    vtag = jnp.where(mesh.vmask, mesh.vtag | vadd, mesh.vtag)
+
+    # --- vertex normals (norver) -----------------------------------------
+    fv = tet_face_vertices(mesh.tet)                       # [T,4,3]
+    acc = jnp.zeros((capP + 1, 3), mesh.vert.dtype)
+    nrm_flat = nrm.reshape(capT * 4, 3)       # area-weighted (non-unit)
+    for c in range(3):
+        tgt = jnp.where(is_bdy_face, fv[:, :, c], capP).reshape(-1)
+        acc = acc.at[tgt].add(nrm_flat, mode="drop")
+    vn = acc[:capP]
+    vn = vn / jnp.maximum(jnp.linalg.norm(vn, axis=-1, keepdims=True), 1e-30)
+    vn = jnp.where(vbdy[:, None], vn, 0.0)
+
+    out = dataclasses.replace(mesh, etag=etag, vtag=vtag)
+    return AnalysisResult(out, vn)

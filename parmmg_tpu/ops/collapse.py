@@ -30,8 +30,8 @@ import jax.numpy as jnp
 
 from ..core.mesh import Mesh
 from ..core.constants import (
-    IDIR, LSHRT, LLONG, EPSD, MG_BDY, MG_CRN, MG_GEO, MG_NOM, MG_REQ,
-    MG_PARBDY, QUAL_FLOOR)
+    IDIR, LSHRT, LLONG, EPSD, MG_BDY, MG_CRN, MG_GEO, MG_NOM, MG_REF,
+    MG_REQ, MG_PARBDY, QUAL_FLOOR)
 from .edges import unique_edges, edge_lengths, unique_priority
 
 _IDIR_J = jnp.asarray(IDIR)
@@ -49,9 +49,15 @@ def _removable(vtag, other_vtag, edge_tag):
     bdy_ok = ~on_bdy | (((edge_tag & MG_BDY) != 0) &
                         ((other_vtag & MG_BDY) != 0))
     on_geo = (vtag & MG_GEO) != 0
+    # a ridge point may slide along its ridge onto another ridge point or
+    # onto the corner terminating the ridge (Mmg chkcol_bdy semantics)
     geo_ok = ~on_geo | (((edge_tag & MG_GEO) != 0) &
-                        ((other_vtag & MG_GEO) != 0))
-    return free & bdy_ok & geo_ok
+                        ((other_vtag & (MG_GEO | MG_CRN)) != 0))
+    # likewise a reference-edge point stays on its reference line
+    on_ref = (vtag & MG_REF) != 0
+    ref_ok = ~on_ref | (((edge_tag & MG_REF) != 0) &
+                        ((other_vtag & (MG_REF | MG_CRN)) != 0))
+    return free & bdy_ok & geo_ok & ref_ok
 
 
 def collapse_wave(mesh: Mesh, met: jax.Array, lmin: float = LSHRT,

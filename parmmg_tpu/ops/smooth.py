@@ -49,10 +49,11 @@ def smooth_wave(mesh: Mesh, met: jax.Array, wave: int = 0,
         acc = acc.at[idx].add(centroid, mode="drop")
         cnt = cnt.at[idx].add(1.0, mode="drop")
     prop = acc[:capP] / jnp.maximum(cnt[:capP, None], 1.0)
-    newpos = mesh.vert + relax * (prop - mesh.vert)
-    newpos = jnp.where(movable[:, None], newpos, mesh.vert)
 
     # --- validity: per-ball min quality must not decrease ----------------
+    # Try a cascade of relaxation factors (Mmg's movtet retries with damped
+    # steps); each vertex takes the largest step whose ball min-quality
+    # strictly improves.
     if met.ndim == 1:
         from .quality import iso_to_tensor
         m6 = iso_to_tensor(met)
@@ -61,22 +62,42 @@ def smooth_wave(mesh: Mesh, met: jax.Array, wave: int = 0,
     mq = m6[tv]                                            # [T,4,6]
     q_old = quality_from_points(vpos, mq)                  # [T]
     minq_old = jnp.full(capP + 1, jnp.inf, mesh.vert.dtype)
-    minq_new = jnp.full(capP + 1, jnp.inf, mesh.vert.dtype)
     for k in range(4):
         idx = jnp.where(mesh.tmask, tv[:, k], capP)
-        p_k = vpos.at[:, k].set(newpos[tv[:, k]])
-        q_new = quality_from_points(p_k, mq)
         minq_old = minq_old.at[idx].min(
             jnp.where(mesh.tmask, q_old, jnp.inf), mode="drop")
-        minq_new = minq_new.at[idx].min(
-            jnp.where(mesh.tmask, q_new, jnp.inf), mode="drop")
-    improves = (minq_new[:capP] > jnp.maximum(minq_old[:capP],
-                                              QUAL_FLOOR)) & movable
+    minq_old = minq_old[:capP]
+
+    newpos = mesh.vert
+    best_gain = jnp.zeros(capP, mesh.vert.dtype)
+    for step in (relax, 0.5 * relax, 0.25 * relax):
+        cand_pos = mesh.vert + step * (prop - mesh.vert)
+        cand_pos = jnp.where(movable[:, None], cand_pos, mesh.vert)
+        minq_new = jnp.full(capP + 1, jnp.inf, mesh.vert.dtype)
+        for k in range(4):
+            idx = jnp.where(mesh.tmask, tv[:, k], capP)
+            p_k = vpos.at[:, k].set(cand_pos[tv[:, k]])
+            q_new = quality_from_points(p_k, mq)
+            minq_new = minq_new.at[idx].min(
+                jnp.where(mesh.tmask, q_new, jnp.inf), mode="drop")
+        gain = minq_new[:capP] - minq_old
+        ok = (minq_new[:capP] > jnp.maximum(minq_old, QUAL_FLOOR)) & movable
+        take = ok & (gain > best_gain)
+        newpos = jnp.where(take[:, None], cand_pos, newpos)
+        best_gain = jnp.where(take, gain, best_gain)
+    improves = best_gain > 0
 
     # --- independent set: vertex claims its ball tets --------------------
+    # wave-rotated hash: full avalanche mix so the *ordering* changes with
+    # the wave even after the float32 cast inside unique_priority (a plain
+    # additive offset is lost to rounding and repeats the same winner set)
     wv = jnp.asarray(wave, jnp.uint32)
-    h = (jnp.arange(capP, dtype=jnp.uint32) * jnp.uint32(2654435761)
-         + (wv * jnp.uint32(40503) + jnp.uint32(1))) & jnp.uint32(0x7FFFFFFF)
+    h = jnp.arange(capP, dtype=jnp.uint32) * jnp.uint32(2654435761)
+    h = h + wv * jnp.uint32(2246822519)
+    h = h ^ (h >> 15)
+    h = h * jnp.uint32(2654435761)
+    h = h ^ (h >> 13)
+    h = h & jnp.uint32(0x7FFFFFFF)
     pri = unique_priority(h.astype(jnp.float32), improves)
     vpri = jnp.where(improves, pri, 0)
     tclaim = jnp.max(jnp.where(mesh.tmask[:, None], vpri[tv], 0), axis=1)

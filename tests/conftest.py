@@ -13,6 +13,19 @@ if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
 
+# The environment may pre-register a real-TPU tunnel backend ("axon") via
+# sitecustomize at interpreter startup; its lazy client creation blocks for
+# minutes when the chip is busy.  Tests run on the virtual CPU mesh only, so
+# drop that backend factory before any jax backend is initialized.
+import jax  # noqa: E402
+
+try:  # pragma: no cover - environment-specific
+    from jax._src import xla_bridge as _xb
+    _xb._backend_factories.pop("axon", None)
+except Exception:
+    pass
+jax.config.update("jax_platforms", "cpu")
+
 # persistent compile cache: the wave kernels are large XLA graphs; caching
 # across pytest processes cuts minutes per run
 os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/root/repo/.jax_cache")

@@ -47,8 +47,18 @@ def test_gradation_limits_growth():
     met[mid] = 0.01
     g = gradation(m, jnp.asarray(met), hgrad=1.3)
     g = np.asarray(g)
-    # neighbors one grid step away (0.25) may be at most 0.01+0.3*dist
-    d = np.linalg.norm(vert - vert[mid], axis=1)
+    # edge-wise gradation bounds h by 0.01 + slope * (shortest edge-graph
+    # path length), not straight-line distance: build the graph distance
+    # oracle with Bellman-Ford over mesh edges
+    from parmmg_tpu.core.mesh import tet_edge_vertices
+    ev = np.asarray(tet_edge_vertices(m.tet)).reshape(-1, 2)
+    ev = ev[np.repeat(np.asarray(m.tmask), 6)]
+    elen = np.linalg.norm(vert[ev[:, 0]] - vert[ev[:, 1]], axis=1)
+    d = np.full(m.capP, np.inf)
+    d[mid] = 0.0
+    for _ in range(30):
+        np.minimum.at(d, ev[:, 0], d[ev[:, 1]] + elen)
+        np.minimum.at(d, ev[:, 1], d[ev[:, 0]] + elen)
     vm = np.asarray(m.vmask)
     bound = 0.01 + 0.3 * d + 1e-5
     assert (g[vm] <= bound[vm] + 1e-6).all()

@@ -18,9 +18,10 @@ from parmmg_tpu.utils.fixtures import cube_mesh
 
 
 def _cube(n=2, capmul=4):
+    from parmmg_tpu.ops.analysis import analyze_mesh
     vert, tet = cube_mesh(n)
     m = make_mesh(vert, tet, capP=capmul * len(vert), capT=capmul * len(tet))
-    return boundary_edge_tags(build_adjacency(m))
+    return analyze_mesh(m).mesh
 
 
 def _check_valid(m, vol_target=1.0):
