@@ -1,0 +1,80 @@
+"""Benchmark: Mtets remeshed/sec/chip on the real device.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Workload: structured cube with a planar-shock isotropic size map (the
+aniso-torus CI analogue of the reference matrix,
+cmake/testing/pmmg_tests.cmake:25-38), adapted by repeated jitted cycles
+(split/collapse/swap/smooth waves).  Throughput = live tets examined per
+wall-second, after one warm-up cycle (compile excluded).
+
+``vs_baseline``: the reference publishes no numbers (BASELINE.md); we use a
+provisional 8-rank MPI/CPU ParMmg estimate of 0.4 Mtets/s (≈50k
+tets/s/rank, typical Mmg-class remesher speed) until a measured CPU
+baseline lands.  North star (BASELINE.json): ≥5x that at equal min quality.
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import numpy as np
+
+BASELINE_MTETS_PER_SEC = 0.4     # provisional 8-rank CPU ParMmg estimate
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from parmmg_tpu.core.mesh import make_mesh
+    from parmmg_tpu.ops.adapt import adapt_cycle
+    from parmmg_tpu.ops.analysis import analyze_mesh
+    from parmmg_tpu.ops.quality import tet_quality
+    from parmmg_tpu.utils.fixtures import cube_mesh, analytic_iso_metric
+
+    n = int(os.environ.get("BENCH_N", "16"))          # 6*n^3 tets
+    cycles = int(os.environ.get("BENCH_CYCLES", "6"))
+
+    vert, tet = cube_mesh(n)
+    mesh = make_mesh(vert, tet, capP=3 * len(vert), capT=3 * len(tet))
+    mesh = analyze_mesh(mesh).mesh
+    h = analytic_iso_metric(vert, "shock", h=1.5 / n)
+    met = jnp.zeros(mesh.capP, mesh.vert.dtype).at[: len(h)].set(
+        jnp.asarray(h, mesh.vert.dtype)).at[len(h):].set(1.0)
+
+    # warm-up (compile)
+    m1, k1, *_ = adapt_cycle(mesh, met, jnp.asarray(0, jnp.int32))
+    jax.block_until_ready(m1.vert)
+
+    total_tets = 0
+    t0 = time.perf_counter()
+    m, k = m1, k1
+    for c in range(cycles):
+        ntet = int(jnp.sum(m.tmask))
+        total_tets += ntet
+        m, k, ns, nc, nw, nm, ovf = adapt_cycle(
+            m, k, jnp.asarray(c + 1, jnp.int32))
+        jax.block_until_ready(m.vert)
+    dt = time.perf_counter() - t0
+
+    mtets_per_sec = total_tets / dt / 1e6
+    q = np.asarray(tet_quality(m))
+    tm = np.asarray(m.tmask)
+    qmin = float(q[tm].min()) if tm.any() else 0.0
+    qmean = float(q[tm].mean()) if tm.any() else 0.0
+
+    print(json.dumps({
+        "metric": "adapt_cycle_throughput",
+        "value": round(mtets_per_sec, 4),
+        "unit": "Mtets/sec/chip",
+        "vs_baseline": round(mtets_per_sec / BASELINE_MTETS_PER_SEC, 3),
+        "extra": {"ntets_final": int(tm.sum()), "qmin": round(qmin, 4),
+                  "qmean": round(qmean, 4), "cycles": cycles,
+                  "device": str(jax.devices()[0].platform)},
+    }))
+
+
+if __name__ == "__main__":
+    main()

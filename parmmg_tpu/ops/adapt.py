@@ -52,12 +52,14 @@ class AdaptStats:
         return self
 
 
-@partial(jax.jit, static_argnames=("do_swap", "do_smooth", "smooth_waves"),
-         donate_argnums=(0, 1))
-def adapt_cycle(mesh: Mesh, met: jax.Array, wave: jax.Array,
-                do_swap: bool = True, do_smooth: bool = True,
-                smooth_waves: int = 2):
-    """One jitted adaptation cycle: split -> collapse -> swap -> smooth."""
+def adapt_cycle_impl(mesh: Mesh, met: jax.Array, wave: jax.Array,
+                     do_swap: bool = True, do_smooth: bool = True,
+                     smooth_waves: int = 2):
+    """One adaptation cycle: split -> collapse -> swap -> smooth.
+
+    Pure jittable function (jitted wrapper below) — also the compile-check
+    entry point exposed by ``__graft_entry__.entry``.
+    """
     res = split_wave(mesh, met)
     mesh, met = res.mesh, res.met
     mesh = build_adjacency(mesh)
@@ -84,6 +86,11 @@ def adapt_cycle(mesh: Mesh, met: jax.Array, wave: jax.Array,
             nmoved = nmoved + sm.nmoved
 
     return mesh, met, nsplit, ncol, nswap, nmoved, overflow
+
+
+adapt_cycle = partial(jax.jit, static_argnames=(
+    "do_swap", "do_smooth", "smooth_waves"),
+    donate_argnums=(0, 1))(adapt_cycle_impl)
 
 
 def grow_mesh_met(mesh: Mesh, met, newP: int, newT: int):

@@ -179,7 +179,9 @@ def merge_shards(shards: Mesh, mets=None):
     vtag2[was_truebdy] |= MG_BDY
     vtag2[was_parbdy & ~was_truebdy] &= ~np.uint32(MG_BDY)
     m = make_mesh(vert[keep], tet, vref=vref[keep], tref=tref)
-    m = dataclasses.replace(m, vtag=jnp.asarray(vtag2.astype(np.uint32)))
+    vtag_full = np.zeros(m.capP, np.uint32)
+    vtag_full[: len(vtag2)] = vtag2
+    m = dataclasses.replace(m, vtag=jnp.asarray(vtag_full))
     m = boundary_edge_tags(build_adjacency(m))
     out_met = None
     if mets is not None:
