@@ -1,0 +1,2 @@
+from .params import IParam, DParam, Info           # noqa: F401
+from .parmesh import ParMesh                        # noqa: F401

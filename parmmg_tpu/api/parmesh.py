@@ -1,0 +1,494 @@
+"""ParMesh: the public mesh-adaptation object (PMMG_ParMesh analogue).
+
+Mirrors the reference's public API surface (libparmmg.h; implementation
+API_functions_pmmg.c) in pythonic form: every ``PMMG_Set_*``/``PMMG_Get_*``
+pair becomes a ``set_*``/``get_*`` method operating on numpy staging
+arrays; the adaptation entries (``PMMG_parmmglib_centralized``
+libparmmg.c:1444, ``_distributed`` :1519) become :meth:`run`.
+
+Design note (TPU-first): the reference keeps per-rank groups of Mmg meshes
+and remeshes them sequentially; here the staging arrays become ONE flat
+device Mesh (core.mesh) adapted by batched waves, and the multi-device
+path shards it over a ``jax.sharding.Mesh`` with frozen interfaces
+(parallel/).  Groups survive only as shards — the migration quantum — so
+the "two-level rank→group decomposition" (SURVEY §2.8) maps to
+device→shard.
+"""
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from ..core import constants as C
+from .params import Info, IParam, DParam
+
+
+def _grow(a: np.ndarray | None, n: int, width: int | None, dtype):
+    shape = (n,) if width is None else (n, width)
+    out = np.zeros(shape, dtype)
+    if a is not None:
+        k = min(len(a), n)
+        out[:k] = a[:k]
+    return out
+
+
+class ParMesh:
+    """Staged mesh + solutions + parameters + (optional) interface comms."""
+
+    def __init__(self, nprocs: int = 1, myrank: int = 0):
+        self.info = Info()
+        self.nprocs = nprocs
+        self.myrank = myrank
+        self.comm = None            # plugged by parallel runs
+        # mesh staging (1-based API ids are converted to 0-based rows)
+        self.np_ = 0
+        self.ne_ = 0
+        self.nt_ = 0
+        self.na_ = 0
+        self.nprism_ = 0
+        self.nquad_ = 0
+        self.vert: np.ndarray | None = None
+        self.vref: np.ndarray | None = None
+        self.vreq: np.ndarray | None = None     # bool required
+        self.vcrn: np.ndarray | None = None     # bool corner
+        self.vnormal: np.ndarray | None = None
+        self.tetra: np.ndarray | None = None
+        self.tref: np.ndarray | None = None
+        self.tetra_req: np.ndarray | None = None
+        self.tria: np.ndarray | None = None
+        self.triaref: np.ndarray | None = None
+        self.tria_req: np.ndarray | None = None
+        self.edge: np.ndarray | None = None
+        self.edgeref: np.ndarray | None = None
+        self.edge_ridge: np.ndarray | None = None
+        self.edge_req: np.ndarray | None = None
+        self.prism: np.ndarray | None = None
+        self.quad: np.ndarray | None = None
+        # metric / ls / displacement / user fields
+        self.met: np.ndarray | None = None      # [np] or [np,6]
+        self.met_type: int = 0                  # 0 none,1 scalar,3 tensor
+        self.ls: np.ndarray | None = None
+        self.disp: np.ndarray | None = None
+        self.fields: list[np.ndarray] = []
+        self.field_types: list[int] = []
+        # distributed-API communicators (Set_ith*Communicator*)
+        self.n_node_comm = 0
+        self.n_face_comm = 0
+        self.node_comms: list[dict] = []
+        self.face_comms: list[dict] = []
+        # outputs
+        self._out = None                        # core Mesh after run()
+        self._out_met = None
+        self._out_stats = None
+        self._glonum = None
+
+    # ------------------------------------------------------------------
+    # sizes
+    # ------------------------------------------------------------------
+    def set_mesh_size(self, np_: int, ne: int, nprism: int = 0, nt: int = 0,
+                      nquad: int = 0, na: int = 0) -> None:
+        """PMMG_Set_meshSize (libparmmg.h:348)."""
+        self.np_, self.ne_, self.nt_, self.na_ = np_, ne, nt, na
+        self.nprism_, self.nquad_ = nprism, nquad
+        self.vert = _grow(self.vert, np_, 3, np.float64)
+        self.vref = _grow(self.vref, np_, None, np.int32)
+        self.vreq = _grow(self.vreq, np_, None, bool)
+        self.vcrn = _grow(self.vcrn, np_, None, bool)
+        self.tetra = _grow(self.tetra, ne, 4, np.int64)
+        self.tref = _grow(self.tref, ne, None, np.int32)
+        self.tetra_req = _grow(self.tetra_req, ne, None, bool)
+        self.tria = _grow(self.tria, nt, 3, np.int64)
+        self.triaref = _grow(self.triaref, nt, None, np.int32)
+        self.tria_req = _grow(self.tria_req, nt, None, bool)
+        self.edge = _grow(self.edge, na, 2, np.int64)
+        self.edgeref = _grow(self.edgeref, na, None, np.int32)
+        self.edge_ridge = _grow(self.edge_ridge, na, None, bool)
+        self.edge_req = _grow(self.edge_req, na, None, bool)
+        self.prism = _grow(self.prism, nprism, 6, np.int64)
+        self.quad = _grow(self.quad, nquad, 4, np.int64)
+
+    def get_mesh_size(self):
+        """PMMG_Get_meshSize."""
+        if self._out is not None:
+            vert, tet, _, _, _ = self._out_host()
+            return len(vert), len(tet), self.nprism_, self._out_ntria(), \
+                self.nquad_, self.na_
+        return self.np_, self.ne_, self.nprism_, self.nt_, self.nquad_, \
+            self.na_
+
+    # ------------------------------------------------------------------
+    # entities (1-based ids, like the reference API)
+    # ------------------------------------------------------------------
+    def set_vertex(self, x, y, z, ref: int, pos: int) -> None:
+        self.vert[pos - 1] = (x, y, z)
+        self.vref[pos - 1] = ref
+
+    def set_vertices(self, coords: np.ndarray, refs=None) -> None:
+        coords = np.asarray(coords, np.float64).reshape(self.np_, 3)
+        self.vert[:] = coords
+        if refs is not None:
+            self.vref[:] = np.asarray(refs, np.int32).reshape(self.np_)
+
+    def set_tetrahedron(self, v0, v1, v2, v3, ref: int, pos: int) -> None:
+        self.tetra[pos - 1] = (v0, v1, v2, v3)
+        self.tref[pos - 1] = ref
+
+    def set_tetrahedra(self, tets: np.ndarray, refs=None) -> None:
+        self.tetra[:] = np.asarray(tets, np.int64).reshape(self.ne_, 4)
+        if refs is not None:
+            self.tref[:] = np.asarray(refs, np.int32).reshape(self.ne_)
+
+    def set_triangle(self, v0, v1, v2, ref: int, pos: int) -> None:
+        self.tria[pos - 1] = (v0, v1, v2)
+        self.triaref[pos - 1] = ref
+
+    def set_triangles(self, tris: np.ndarray, refs=None) -> None:
+        self.tria[:] = np.asarray(tris, np.int64).reshape(self.nt_, 3)
+        if refs is not None:
+            self.triaref[:] = np.asarray(refs, np.int32).reshape(self.nt_)
+
+    def set_edge(self, v0, v1, ref: int, pos: int) -> None:
+        self.edge[pos - 1] = (v0, v1)
+        self.edgeref[pos - 1] = ref
+
+    def set_edges(self, edges: np.ndarray, refs=None) -> None:
+        self.edge[:] = np.asarray(edges, np.int64).reshape(self.na_, 2)
+        if refs is not None:
+            self.edgeref[:] = np.asarray(refs, np.int32).reshape(self.na_)
+
+    def set_prism(self, vs, ref: int, pos: int) -> None:
+        self.prism[pos - 1] = vs
+
+    def set_quadrilateral(self, vs, ref: int, pos: int) -> None:
+        self.quad[pos - 1] = vs
+
+    def set_corner(self, pos: int) -> None:
+        self.vcrn[pos - 1] = True
+
+    def set_required_vertex(self, pos: int) -> None:
+        self.vreq[pos - 1] = True
+
+    def set_required_tetrahedron(self, pos: int) -> None:
+        self.tetra_req[pos - 1] = True
+
+    def set_required_triangle(self, pos: int) -> None:
+        self.tria_req[pos - 1] = True
+
+    def set_required_edge(self, pos: int) -> None:
+        self.edge_req[pos - 1] = True
+
+    def set_ridge(self, pos: int) -> None:
+        self.edge_ridge[pos - 1] = True
+
+    def set_normal_at_vertex(self, pos: int, nx, ny, nz) -> None:
+        if self.vnormal is None:
+            self.vnormal = np.zeros((self.np_, 3))
+        self.vnormal[pos - 1] = (nx, ny, nz)
+
+    # ------------------------------------------------------------------
+    # metric & solutions
+    # ------------------------------------------------------------------
+    def set_met_size(self, typ: int, np_: int) -> None:
+        """typ: 1=scalar, 3=tensor (MMG5_Scalar/MMG5_Tensor)."""
+        if np_ != self.np_:
+            raise ValueError("metric size must match vertex count")
+        self.met_type = typ
+        width = None if typ == 1 else 6
+        self.met = _grow(None, np_, width, np.float64)
+
+    def set_scalar_met(self, m: float, pos: int) -> None:
+        self.met[pos - 1] = m
+
+    def set_scalar_mets(self, m: np.ndarray) -> None:
+        self.met[:] = np.asarray(m, np.float64).reshape(self.np_)
+
+    def set_tensor_met(self, m11, m12, m13, m22, m23, m33, pos: int) -> None:
+        self.met[pos - 1] = (m11, m12, m13, m22, m23, m33)
+
+    def set_tensor_mets(self, m: np.ndarray) -> None:
+        self.met[:] = np.asarray(m, np.float64).reshape(self.np_, 6)
+
+    def set_sols_at_vertices_size(self, nsols: int, types: list[int]) -> None:
+        """PMMG_Set_solsAtVerticesSize: declare user fields."""
+        self.fields = []
+        self.field_types = list(types)
+        for t in types:
+            width = {1: None, 2: 3, 3: 6}[t]
+            self.fields.append(_grow(None, self.np_, width, np.float64))
+
+    def set_ith_sol_in_sols_at_vertices(self, i: int, vals: np.ndarray)\
+            -> None:
+        f = self.fields[i - 1]
+        self.fields[i - 1] = np.asarray(vals, np.float64).reshape(f.shape)
+
+    def get_ith_sol_in_sols_at_vertices(self, i: int) -> np.ndarray:
+        return self.fields[i - 1]
+
+    # ------------------------------------------------------------------
+    # parameters
+    # ------------------------------------------------------------------
+    def set_iparameter(self, key: IParam, val: int) -> None:
+        self.info.set_iparameter(key, val)
+
+    def set_dparameter(self, key: DParam, val: float) -> None:
+        self.info.set_dparameter(key, val)
+
+    # ------------------------------------------------------------------
+    # distributed-API communicators (libparmmg.h Set_ith*Communicator*)
+    # ------------------------------------------------------------------
+    def set_number_of_node_communicators(self, n: int) -> None:
+        self.n_node_comm = n
+        self.node_comms = [dict(color_out=-1, local=None, global_=None)
+                           for _ in range(n)]
+
+    def set_number_of_face_communicators(self, n: int) -> None:
+        self.n_face_comm = n
+        self.face_comms = [dict(color_out=-1, local=None, global_=None)
+                           for _ in range(n)]
+
+    def set_ith_node_communicator_size(self, i: int, color_out: int,
+                                       nitem: int) -> None:
+        c = self.node_comms[i]
+        c["color_out"] = color_out
+        c["local"] = np.zeros(nitem, np.int64)
+        c["global_"] = np.zeros(nitem, np.int64)
+
+    def set_ith_face_communicator_size(self, i: int, color_out: int,
+                                       nitem: int) -> None:
+        c = self.face_comms[i]
+        c["color_out"] = color_out
+        c["local"] = np.zeros(nitem, np.int64)
+        c["global_"] = np.zeros(nitem, np.int64)
+
+    def set_ith_node_communicator_nodes(self, i: int, local_ids, global_ids,
+                                        is_not_ordered: bool = True) -> None:
+        """Items must appear in the same order on both sides of a rank
+        pair; with ``is_not_ordered`` they are sorted by global id (the
+        ordering contract, reference API_functions_pmmg.c:1295-1330)."""
+        c = self.node_comms[i]
+        lo = np.asarray(local_ids, np.int64)
+        gl = np.asarray(global_ids, np.int64)
+        if is_not_ordered:
+            o = np.argsort(gl, kind="stable")
+            lo, gl = lo[o], gl[o]
+        c["local"], c["global_"] = lo, gl
+
+    def set_ith_face_communicator_faces(self, i: int, local_ids, global_ids,
+                                        is_not_ordered: bool = True) -> None:
+        c = self.face_comms[i]
+        lo = np.asarray(local_ids, np.int64)
+        gl = np.asarray(global_ids, np.int64)
+        if is_not_ordered:
+            o = np.argsort(gl, kind="stable")
+            lo, gl = lo[o], gl[o]
+        c["local"], c["global_"] = lo, gl
+
+    def get_number_of_node_communicators(self) -> int:
+        return self.n_node_comm
+
+    def get_number_of_face_communicators(self) -> int:
+        return self.n_face_comm
+
+    def get_ith_node_communicator_size(self, i: int):
+        c = self.node_comms[i]
+        return c["color_out"], len(c["local"])
+
+    def get_ith_face_communicator_size(self, i: int):
+        c = self.face_comms[i]
+        return c["color_out"], len(c["local"])
+
+    def get_ith_node_communicator_nodes(self, i: int):
+        return self.node_comms[i]["local"]
+
+    def get_ith_face_communicator_faces(self, i: int):
+        return self.face_comms[i]["local"]
+
+    def check_set_node_communicators(self) -> bool:
+        """Coordinate-based sanity check of the user comms
+        (PMMG_Check_Set_NodeCommunicators, chkcomm oracle flavor).
+        Single-process form: verify ids are in range and orderings are
+        self-consistent (pairwise exchange happens in parallel/comms)."""
+        for c in self.node_comms:
+            if c["local"] is None:
+                return False
+            if (np.asarray(c["local"]) < 1).any() or \
+                    (np.asarray(c["local"]) > self.np_).any():
+                return False
+        return True
+
+    # ------------------------------------------------------------------
+    # run
+    # ------------------------------------------------------------------
+    def _build_core_mesh(self):
+        """Assemble the staged arrays into a core Mesh + metric."""
+        import jax.numpy as jnp
+        from ..core.mesh import make_mesh
+        from ..ops.analysis import analyze_mesh
+
+        if self.np_ == 0 or self.ne_ == 0:
+            raise ValueError("mesh size not set")
+        tets0 = self.tetra - 1                     # 1-based -> 0-based
+        mesh = make_mesh(self.vert, tets0.astype(np.int32),
+                         vref=self.vref, tref=self.tref)
+        # geometric analysis first (ridges/corners/normals from dihedrals)
+        mesh = analyze_mesh(
+            mesh, angedg=np.cos(np.deg2rad(self.info.angle_deg))
+            if self.info.angle_detection else -1.1).mesh
+
+        # overlay user-required / corner / ridge flags
+        vtag = np.array(np.asarray(mesh.vtag), copy=True)
+        vtag[: self.np_][self.vreq] |= C.MG_REQ
+        vtag[: self.np_][self.vcrn] |= C.MG_CRN
+        mesh = dataclasses.replace(mesh, vtag=jnp.asarray(vtag))
+
+        # user triangles: push refs onto matching boundary faces
+        if self.nt_:
+            mesh = self._apply_user_triangles(mesh)
+        if self.na_:
+            mesh = self._apply_user_edges(mesh)
+
+        # metric
+        cap = mesh.capP
+        if self.met is None or self.met_type == 0:
+            met = None
+        elif self.met_type == 1:
+            met = np.zeros(cap)
+            met[: self.np_] = self.met
+            met[self.np_:] = 1.0
+        else:
+            met = np.zeros((cap, 6))
+            met[: self.np_] = self.met
+            met[self.np_:] = np.array([1, 0, 0, 1, 0, 1.0])
+        return mesh, (jnp.asarray(met) if met is not None else None)
+
+    def _apply_user_triangles(self, mesh):
+        """Match user boundary triangles to tet faces; transfer refs and
+        required tags (what Mmg does from the Triangles field)."""
+        import jax.numpy as jnp
+        from ..core.mesh import tet_face_vertices
+
+        fv = np.sort(np.asarray(tet_face_vertices(mesh.tet)), axis=2)
+        capT = mesh.capT
+        keys = fv.reshape(capT * 4, 3)
+        tria = np.sort(self.tria - 1, axis=1)
+        # dict-free matching: concatenate + lexsort
+        allk = np.concatenate([keys, tria])
+        tag = np.concatenate([np.full(capT * 4, -1),
+                              np.arange(len(tria))])
+        o = np.lexsort(allk.T[::-1])
+        ks, ts = allk[o], tag[o]
+        same = (ks[1:] == ks[:-1]).all(axis=1)
+        ftag = np.array(np.asarray(mesh.ftag), copy=True).reshape(-1)
+        fref = np.array(np.asarray(mesh.fref), copy=True).reshape(-1)
+        slot = np.where(ts < 0, o, -1)   # position in keys if a face row
+        for a, b in [(np.arange(len(same)), np.arange(1, len(same) + 1)),
+                     (np.arange(1, len(same) + 1), np.arange(len(same)))]:
+            pair = same & (ts[a] < 0) & (ts[b] >= 0) \
+                if len(same) else np.zeros(0, bool)
+            faces = slot[a][pair]
+            tids = ts[b][pair]
+            fref[faces] = self.triaref[tids]
+            ftag[faces] |= np.where(self.tria_req[tids],
+                                    np.uint32(C.MG_REQ), np.uint32(0))
+        return dataclasses.replace(
+            mesh, ftag=jnp.asarray(ftag.reshape(capT, 4)),
+            fref=jnp.asarray(fref.reshape(capT, 4)))
+
+    def _apply_user_edges(self, mesh):
+        """Transfer user edge refs/ridge/required onto tet edge slots."""
+        import jax.numpy as jnp
+        from ..core.mesh import tet_edge_vertices
+
+        ev = np.asarray(tet_edge_vertices(mesh.tet))
+        capT = mesh.capT
+        ev2 = np.sort(ev.reshape(capT * 6, 2), axis=1)
+        ue = np.sort(self.edge - 1, axis=1)
+        etag = np.array(np.asarray(mesh.etag), copy=True).reshape(-1)
+        add = np.where(self.edge_ridge, np.uint32(C.MG_GEO), 0) | \
+            np.where(self.edge_req, np.uint32(C.MG_REQ), 0) | \
+            np.where(self.edgeref != 0, np.uint32(C.MG_REF), 0)
+        key = ev2[:, 0].astype(np.int64) << 32 | ev2[:, 1]
+        ukey = ue[:, 0].astype(np.int64) << 32 | ue[:, 1]
+        o = np.argsort(ukey)
+        pos = np.searchsorted(ukey[o], key)
+        pos = np.clip(pos, 0, len(ukey) - 1)
+        hit = ukey[o][pos] == key
+        etag[hit] |= add[o][pos[hit]].astype(np.uint32)
+        return dataclasses.replace(
+            mesh, etag=jnp.asarray(etag.reshape(capT, 6)))
+
+    def run(self) -> int:
+        """The adaptation entry (PMMG_parmmglib_centralized /_distributed
+        depending on staged comms).  Returns PMMG_SUCCESS/…"""
+        from ..driver import parmmg_run
+        try:
+            out, met, stats = parmmg_run(self)
+        except MemoryError:
+            return C.PMMG_STRONGFAILURE
+        self._out, self._out_met, self._out_stats = out, met, stats
+        return C.PMMG_SUCCESS
+
+    # ------------------------------------------------------------------
+    # output getters
+    # ------------------------------------------------------------------
+    def _out_host(self):
+        from ..core.mesh import mesh_to_host
+        if self._out is None:
+            raise RuntimeError("run() first")
+        return mesh_to_host(self._out)
+
+    def _out_ntria(self) -> int:
+        m = self._out
+        ftag = np.asarray(m.ftag)
+        return int((((ftag & C.MG_BDY) != 0)
+                    & np.asarray(m.tmask)[:, None]).sum())
+
+    def get_vertices(self):
+        vert, tet, vref, tref, vtag = self._out_host()
+        return vert, vref
+
+    def get_tetrahedra(self):
+        vert, tet, vref, tref, vtag = self._out_host()
+        return tet + 1, tref                       # back to 1-based
+
+    def get_triangles(self):
+        """Boundary faces of the adapted mesh as (tria [nt,3] 1-based,
+        refs)."""
+        from ..core.mesh import tet_face_vertices, mesh_to_host
+        m = self._out
+        vm = np.asarray(m.vmask)
+        new_id = np.cumsum(vm) - 1
+        fv = np.asarray(tet_face_vertices(m.tet))
+        ftag = np.asarray(m.ftag)
+        sel = ((ftag & C.MG_BDY) != 0) & np.asarray(m.tmask)[:, None]
+        tris = new_id[fv[sel]] + 1
+        refs = np.asarray(m.fref)[sel]
+        return tris, refs
+
+    def get_metric(self):
+        if self._out_met is None:
+            return None
+        m = np.asarray(self._out_met)
+        vm = np.asarray(self._out.vmask)
+        return m[vm]
+
+    def get_vertex_glonum(self, pos: int) -> int:
+        if self._glonum is None:
+            self._compute_glonum()
+        return int(self._glonum[pos - 1])
+
+    def get_vertices_glonum(self) -> np.ndarray:
+        if self._glonum is None:
+            self._compute_glonum()
+        return self._glonum
+
+    def _compute_glonum(self):
+        """Output global numbering (single-process: identity; multi-shard
+        handled by parallel.comms.global_node_numbering)."""
+        vert, _, _, _, _ = self._out_host()
+        self._glonum = np.arange(1, len(vert) + 1, dtype=np.int64)
+
+    @property
+    def stats(self):
+        return self._out_stats

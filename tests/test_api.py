@@ -1,0 +1,150 @@
+"""API-layer tests: the manual cube walkthrough of the reference examples.
+
+Mirrors libexamples/adaptation_example0 (sequential_IO/manual cube: build a
+mesh by hand through the Set_* API, adapt, read results back through
+Get_*) — the reference runs these as CI tests (pmmg_tests.cmake:324-591).
+"""
+import numpy as np
+import pytest
+
+from parmmg_tpu.api import ParMesh, IParam, DParam
+from parmmg_tpu.core import constants as C
+from parmmg_tpu.utils.fixtures import cube_mesh
+
+
+def _staged_cube(n=2, **ipar):
+    vert, tet = cube_mesh(n)
+    pm = ParMesh()
+    pm.set_mesh_size(np_=len(vert), ne=len(tet))
+    pm.set_vertices(vert)
+    pm.set_tetrahedra(tet + 1)                      # API is 1-based
+    for k, v in ipar.items():
+        pm.set_iparameter(getattr(IParam, k), v)
+    return pm, vert, tet
+
+
+def test_manual_cube_roundtrip_no_adapt():
+    pm, vert, tet = _staged_cube(2, niter=1, noinsert=1, noswap=1, nomove=1)
+    pm.set_dparameter(DParam.hsiz, 0.5)             # current size: no-op
+    ret = pm.run()
+    assert ret == C.PMMG_SUCCESS
+    npo, ne, *_ = pm.get_mesh_size()
+    assert npo > 0 and ne > 0
+    v, vr = pm.get_vertices()
+    t, tr = pm.get_tetrahedra()
+    assert t.min() >= 1 and t.max() <= len(v)
+    # volume conserved
+    p = v[t - 1]
+    vol = np.einsum("ti,ti->t", p[:, 1] - p[:, 0],
+                    np.cross(p[:, 2] - p[:, 0], p[:, 3] - p[:, 0])) / 6
+    assert np.isclose(vol.sum(), 1.0, rtol=1e-4)
+
+
+def test_manual_cube_refine():
+    pm, vert, tet = _staged_cube(2, niter=1)
+    pm.set_met_size(1, len(vert))
+    pm.set_scalar_mets(np.full(len(vert), 0.26))
+    ret = pm.run()
+    assert ret == C.PMMG_SUCCESS
+    v, _ = pm.get_vertices()
+    assert len(v) > len(vert)                        # refined
+    assert pm.stats.nsplit > 0
+    tris, refs = pm.get_triangles()
+    assert len(tris) > 0
+
+
+def test_scalar_met_setters_individual():
+    pm, vert, tet = _staged_cube(1, niter=1)
+    pm.set_met_size(1, len(vert))
+    for i in range(len(vert)):
+        pm.set_scalar_met(0.9, i + 1)
+    assert pm.run() == C.PMMG_SUCCESS
+
+
+def test_required_vertex_survives():
+    pm, vert, tet = _staged_cube(2, niter=1)
+    # mark an interior vertex required: it must survive coarsening
+    interior = np.where(~(((vert == 0) | (vert == 1)).any(axis=1)))[0]
+    vid = int(interior[0])
+    pm.set_required_vertex(vid + 1)
+    pm.set_met_size(1, len(vert))
+    pm.set_scalar_mets(np.full(len(vert), 2.0))      # coarsen hard
+    assert pm.run() == C.PMMG_SUCCESS
+    v, _ = pm.get_vertices()
+    d = np.abs(v - vert[vid]).sum(axis=1).min()
+    assert d < 1e-6
+
+
+def test_fields_interpolated():
+    pm, vert, tet = _staged_cube(2, niter=1)
+    pm.set_met_size(1, len(vert))
+    pm.set_scalar_mets(np.full(len(vert), 0.3))
+    pm.set_sols_at_vertices_size(1, [1])
+    coef = np.array([2.0, -1.0, 0.5])
+    pm.set_ith_sol_in_sols_at_vertices(1, vert @ coef)
+    assert pm.run() == C.PMMG_SUCCESS
+    v, _ = pm.get_vertices()
+    f = pm.get_ith_sol_in_sols_at_vertices(1)
+    assert len(f) == len(v)
+    # linear field must be reproduced (P1 interpolation is exact)
+    assert np.allclose(f, v @ coef, atol=5e-3)
+
+
+def test_user_triangle_refs_preserved():
+    vert, tet = cube_mesh(2)
+    # user declares the z=0 face triangles with ref 7
+    pm = ParMesh()
+    faces = []
+    # brute-force boundary triangles of z=0 plane from the tets
+    from parmmg_tpu.core.constants import IDIR
+    for t in tet:
+        for f in range(4):
+            tri = t[IDIR[f]]
+            if (vert[tri][:, 2] == 0).all():
+                faces.append(tri + 1)
+    faces = np.array(faces)
+    pm.set_mesh_size(np_=len(vert), ne=len(tet), nt=len(faces))
+    pm.set_vertices(vert)
+    pm.set_tetrahedra(tet + 1)
+    pm.set_triangles(faces, refs=np.full(len(faces), 7))
+    pm.set_iparameter(IParam.niter, 1)
+    pm.set_met_size(1, len(vert))
+    pm.set_scalar_mets(np.full(len(vert), 0.35))
+    assert pm.run() == C.PMMG_SUCCESS
+    tris, refs = pm.get_triangles()
+    v, _ = pm.get_vertices()
+    on_z0 = np.isclose(v[tris - 1][:, :, 2], 0).all(axis=1)
+    assert on_z0.any()
+    # every z=0 output triangle carries ref 7
+    assert (refs[on_z0] == 7).all()
+    assert (refs[~on_z0] != 7).all()
+
+
+def test_iparam_dparam_surface():
+    pm = ParMesh()
+    pm.set_iparameter(IParam.verbose, 5)
+    pm.set_iparameter(IParam.niter, 2)
+    pm.set_iparameter(IParam.APImode, C.APIDISTRIB_NODES)
+    pm.set_dparameter(DParam.hmin, 0.01)
+    pm.set_dparameter(DParam.hmax, 1.0)
+    pm.set_dparameter(DParam.hgrad, 1.2)
+    assert pm.info.imprim == 5
+    assert pm.info.niter == 2
+    assert pm.info.api_mode == C.APIDISTRIB_NODES
+    assert pm.info.hmin == 0.01
+    with pytest.raises(KeyError):
+        pm.set_iparameter(IParam.lag, 1)
+
+
+def test_node_communicator_api_roundtrip():
+    pm = ParMesh(nprocs=2, myrank=0)
+    pm.set_mesh_size(np_=8, ne=6)
+    pm.set_number_of_node_communicators(1)
+    pm.set_ith_node_communicator_size(0, color_out=1, nitem=4)
+    pm.set_ith_node_communicator_nodes(
+        0, [3, 1, 4, 2], [30, 10, 40, 20], is_not_ordered=True)
+    col, n = pm.get_ith_node_communicator_size(0)
+    assert (col, n) == (1, 4)
+    # sorted by global id per the ordering contract
+    assert pm.get_ith_node_communicator_nodes(0).tolist() == [1, 2, 3, 4]
+    assert pm.check_set_node_communicators()
