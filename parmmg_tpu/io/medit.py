@@ -130,6 +130,17 @@ def _read_mesh_ascii(path: Path) -> MeditMesh:
             n = int(next(it))
             m.required_tria = np.fromiter((next(it) for _ in range(n)), float,
                                           count=n).astype(np.int64).astype(np.int32) - 1
+        elif kw in ("ParallelTriangleCommunicators",
+                    "ParallelVertexCommunicators"):
+            # distributed extensions: consumed by io.distributed, which
+            # re-reads the file; here skip the whole section
+            ncomm = int(next(it))
+            nit_tot = 0
+            for _ in range(ncomm):
+                next(it)                    # color
+                nit_tot += int(next(it))    # nitem
+            for _ in range(2 * nit_tot):
+                next(it)
         else:
             # unknown section: assume "n" then n lines we cannot size — bail
             raise ValueError(f"unsupported Medit keyword: {kw}")

@@ -1,0 +1,100 @@
+"""VTK XML output: .vtu per shard + .pvtu master (pure Python).
+
+Equivalent of the reference's VTK path (inoutcpp_pmmg.cpp:44-116,
+``PMMG_savePvtuMesh`` writing parallel .pvtu through Mmg's VTK templates)
+without the VTK library: we emit ascii VTU XML directly.
+"""
+from __future__ import annotations
+
+from pathlib import Path
+
+import numpy as np
+
+_VTK_TETRA = 10
+
+
+def write_vtu(path: str | Path, vert: np.ndarray, tet: np.ndarray,
+              point_data: dict[str, np.ndarray] | None = None,
+              cell_data: dict[str, np.ndarray] | None = None) -> Path:
+    path = Path(path)
+    n_p, n_c = len(vert), len(tet)
+    lines = []
+    a = lines.append
+    a('<?xml version="1.0"?>')
+    a('<VTKFile type="UnstructuredGrid" version="0.1" '
+      'byte_order="LittleEndian">')
+    a('  <UnstructuredGrid>')
+    a(f'    <Piece NumberOfPoints="{n_p}" NumberOfCells="{n_c}">')
+    a('      <Points>')
+    a('        <DataArray type="Float64" NumberOfComponents="3" '
+      'format="ascii">')
+    for p in np.asarray(vert, np.float64):
+        a(f"          {p[0]:.17g} {p[1]:.17g} {p[2]:.17g}")
+    a('        </DataArray>')
+    a('      </Points>')
+    a('      <Cells>')
+    a('        <DataArray type="Int64" Name="connectivity" format="ascii">')
+    for t in np.asarray(tet, np.int64):
+        a("          " + " ".join(map(str, t)))
+    a('        </DataArray>')
+    a('        <DataArray type="Int64" Name="offsets" format="ascii">')
+    a("          " + " ".join(str(4 * (i + 1)) for i in range(n_c)))
+    a('        </DataArray>')
+    a('        <DataArray type="UInt8" Name="types" format="ascii">')
+    a("          " + " ".join([str(_VTK_TETRA)] * n_c))
+    a('        </DataArray>')
+    a('      </Cells>')
+
+    def data_block(tag, data):
+        if not data:
+            return
+        a(f'      <{tag}>')
+        for name, arr in data.items():
+            arr = np.asarray(arr)
+            nc = 1 if arr.ndim == 1 else arr.shape[1]
+            a(f'        <DataArray type="Float64" Name="{name}" '
+              f'NumberOfComponents="{nc}" format="ascii">')
+            for row in arr.reshape(len(arr), -1):
+                a("          " + " ".join(f"{x:.17g}" for x in row))
+            a('        </DataArray>')
+        a(f'      </{tag}>')
+
+    data_block("PointData", point_data)
+    data_block("CellData", cell_data)
+    a('    </Piece>')
+    a('  </UnstructuredGrid>')
+    a('</VTKFile>')
+    path.write_text("\n".join(lines) + "\n")
+    return path
+
+
+def write_pvtu(path: str | Path, piece_files: list[str | Path],
+               point_data: dict[str, int] | None = None,
+               cell_data: dict[str, int] | None = None) -> Path:
+    """Master file referencing per-shard .vtu pieces
+    (PMMG_savePvtuMesh analogue).  ``point_data``/``cell_data`` map field
+    name -> number of components."""
+    path = Path(path)
+    lines = []
+    a = lines.append
+    a('<?xml version="1.0"?>')
+    a('<VTKFile type="PUnstructuredGrid" version="0.1" '
+      'byte_order="LittleEndian">')
+    a('  <PUnstructuredGrid GhostLevel="0">')
+    a('    <PPoints>')
+    a('      <PDataArray type="Float64" NumberOfComponents="3"/>')
+    a('    </PPoints>')
+    for tag, data in (("PPointData", point_data),
+                      ("PCellData", cell_data)):
+        if data:
+            a(f'    <{tag}>')
+            for name, nc in data.items():
+                a(f'      <PDataArray type="Float64" Name="{name}" '
+                  f'NumberOfComponents="{nc}"/>')
+            a(f'    </{tag}>')
+    for f in piece_files:
+        a(f'    <Piece Source="{Path(f).name}"/>')
+    a('  </PUnstructuredGrid>')
+    a('</VTKFile>')
+    path.write_text("\n".join(lines) + "\n")
+    return path
