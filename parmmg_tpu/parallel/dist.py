@@ -90,7 +90,7 @@ def dist_adapt_cycle(dmesh: DeviceMesh):
     fn = shard_map(local_cycle, mesh=dmesh,
                    in_specs=(spec, spec, P()),
                    out_specs=(spec, spec, P(), P()),
-                   check_rep=False)
+                   check_vma=False)
     return jax.jit(fn)
 
 
@@ -113,7 +113,7 @@ def dist_quality(dmesh: DeviceMesh):
         return counts, qmin, qsum / jnp.maximum(ntot, 1), nbad, ntot
 
     fn = shard_map(local, mesh=dmesh, in_specs=(spec, spec),
-                   out_specs=(P(), P(), P(), P(), P()), check_rep=False)
+                   out_specs=(P(), P(), P(), P(), P()), check_vma=False)
     return jax.jit(fn)
 
 

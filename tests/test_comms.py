@@ -112,7 +112,7 @@ def test_halo_exchange_coordinate_echo():
 
     fn = shard_map(body, mesh=dmesh,
                    in_specs=(P("shard"), P("shard"), P("shard")),
-                   out_specs=P("shard"), check_rep=False)
+                   out_specs=P("shard"), check_vma=False)
     out = jax.jit(fn)(jnp.asarray(coords),
                       jnp.asarray(comms.node_idx),
                       jnp.asarray(comms.nbr))
