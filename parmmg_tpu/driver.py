@@ -81,12 +81,24 @@ def parmmg_run(pm) -> tuple[Mesh, object, AdaptStats]:
             stats += st
     else:
         from .parallel.dist import distributed_adapt
-        for it in range(max(1, info.niter)):
+        from .parallel.partition import move_interfaces
+        from .ops.analysis import analyze_mesh
+        part = None
+        niter = max(1, info.niter)
+        for it in range(niter):
             mesh, met, part = distributed_adapt(
-                mesh, met, info.n_devices,
+                mesh, met, info.n_devices, part=part,
                 verbose=3 if info.imprim >= C.PMMG_VERB_ITWAVES else 0)
-            from .ops.analysis import analyze_mesh
             mesh = analyze_mesh(mesh).mesh
+            if it + 1 < niter and not info.nobalancing \
+                    and info.repartitioning == C.REPART_IFC_DISPLACEMENT:
+                # displace old interfaces into shard interiors so the
+                # next pass can remesh them (loadbalancing_pmmg.c flow)
+                _, tet_h, _, _, _ = mesh_to_host(mesh)
+                part = move_interfaces(tet_h, part, info.n_devices,
+                                       nlayers=info.ifc_layers)
+            elif it + 1 < niter:
+                part = None          # fresh graph partition next iter
 
     # interpolate user fields old mesh -> new mesh
     if bg_fields:

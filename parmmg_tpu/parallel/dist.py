@@ -119,12 +119,16 @@ def dist_quality(dmesh: DeviceMesh):
 
 def distributed_adapt(mesh: Mesh, met, n_shards: int,
                       cycles: int = 10, dmesh: DeviceMesh | None = None,
-                      partitioner: str = "morton", verbose: int = 0):
+                      partitioner: str = "morton", verbose: int = 0,
+                      part: np.ndarray | None = None):
     """One outer remesh pass on n_shards devices (host driver).
 
-    partition -> freeze interfaces -> SPMD adapt cycles -> merge.
-    The iterate-with-interface-displacement outer loop lives in
-    api/driver (PMMG_parmmglib1 analogue).
+    partition (or take the caller's displaced ``part``) -> freeze
+    interfaces -> SPMD adapt cycles -> merge.  Returns
+    (merged mesh, met, part_of_merged): the partition labels of the NEW
+    tets (= source shard), which the caller displaces with
+    ``move_interfaces`` before the next outer iteration — the
+    remesh-and-repartition scheme of PMMG_parmmglib1/loadbalancing.
     """
     from ..core.mesh import tet_volumes, mesh_to_host
     from .partition import morton_partition, greedy_partition, fix_contiguity
@@ -134,19 +138,25 @@ def distributed_adapt(mesh: Mesh, met, n_shards: int,
         dmesh = make_device_mesh(n_shards)
 
     vert, tet, vref, tref, vtag = mesh_to_host(mesh)
-    cent = vert[tet].mean(axis=1)
-    if partitioner == "morton":
-        part = morton_partition(cent, n_shards)
-    else:
-        part = greedy_partition(tet, cent, n_shards)
-    part = fix_contiguity(tet, part)
+    if part is None:
+        cent = vert[tet].mean(axis=1)
+        if partitioner == "morton":
+            part = morton_partition(cent, n_shards)
+        else:
+            part = greedy_partition(tet, cent, n_shards)
+        part = fix_contiguity(tet, part)
 
-    stacked, met_s = split_to_shards(mesh, met, part, n_shards)
-    stacked = shard_stacked(stacked, dmesh)
-    met_s = shard_stacked(met_s, dmesh)
-
+    cap_mult = 3.0
     step = dist_adapt_cycle(dmesh)
-    for c in range(cycles):
+    stacked = met_s = None
+    c = 0
+    regrows = 0
+    while c < cycles:
+        if stacked is None:
+            s, ms = split_to_shards(mesh, met, part, n_shards,
+                                    cap_mult=cap_mult)
+            stacked = shard_stacked(s, dmesh)
+            met_s = shard_stacked(ms, dmesh)
         stacked, met_s, counts, ovf = step(stacked, met_s,
                                            jnp.asarray(c, jnp.int32))
         cs = np.asarray(counts)
@@ -154,8 +164,21 @@ def distributed_adapt(mesh: Mesh, met, n_shards: int,
             print(f"  dist cycle {c}: split {cs[0]} collapse {cs[1]} "
                   f"swap {cs[2]} move {cs[3]}")
         if int(ovf) != 0:
-            raise MemoryError("shard capacity overflow — raise cap_mult")
+            # shard capacity exhausted: merge, double headroom, re-split
+            # with the same partition and continue (the static-shape
+            # analogue of the reference's realloc/memory repartition,
+            # zaldy_pmmg.c:140-254)
+            if regrows >= 6:
+                raise MemoryError("shard capacity overflow")
+            mesh, met, part = merge_shards(stacked, met_s,
+                                           return_part=True)
+            cap_mult *= 2.0
+            regrows += 1
+            stacked = None
+            continue
+        c += 1
         if cs[0] == 0 and cs[1] == 0 and cs[2] == 0:
             break
-    merged, met_m = merge_shards(stacked, met_s)
-    return merged, met_m, part
+    merged, met_m, part_new = merge_shards(stacked, met_s,
+                                           return_part=True)
+    return merged, met_m, part_new

@@ -126,15 +126,18 @@ def split_to_shards(mesh: Mesh, met, part: np.ndarray, nparts: int,
     return stacked, met_stacked
 
 
-def merge_shards(shards: Mesh, mets=None):
+def merge_shards(shards: Mesh, mets=None, return_part: bool = False):
     """Merge stacked shard Meshes back into one host Mesh (+ metric).
 
     Interface vertices are deduplicated by exact coordinate bytes — valid
     because MG_PARBDY points are frozen during shard-local adaptation.
+    With ``return_part``, also returns the source-shard label of every
+    merged tet (a valid partition of the merged mesh, ready for
+    interface displacement).
     """
     nsh = shards.vert.shape[0]
     all_v, all_tag, all_ref, all_met = [], [], [], []
-    all_t, all_tref = [], []
+    all_t, all_tref, all_src = [], [], []
     offsets = []
     off = 0
     for s in range(nsh):
@@ -145,6 +148,7 @@ def merge_shards(shards: Mesh, mets=None):
         all_ref.append(vref)
         all_t.append(tet + off)
         all_tref.append(tref)
+        all_src.append(np.full(len(tet), s, np.int32))
         if mets is not None:
             mh = np.asarray(mets[s])[np.asarray(one.vmask)]
             all_met.append(mh)
@@ -189,4 +193,6 @@ def merge_shards(shards: Mesh, mets=None):
         full = np.zeros((m.capP,) + met.shape[1:], met.dtype)
         full[: len(met)] = met
         out_met = jnp.asarray(full)
+    if return_part:
+        return m, out_met, np.concatenate(all_src)
     return m, out_met

@@ -124,6 +124,153 @@ def greedy_partition(tet: np.ndarray, centroids: np.ndarray, nparts: int,
     return part
 
 
+def metric_edge_weights(tet: np.ndarray, vert: np.ndarray,
+                        met: np.ndarray,
+                        ifc_pairs: tuple[np.ndarray, np.ndarray] | None
+                        = None, alpha: float = 28.0) -> dict:
+    """Metric-aware dual-graph edge weights (PMMG_computeWgt,
+    /root/reference/src/metis_pmmg.c:280-300): a face between two tets
+    whose edges are far from unit metric length gets weight
+    ``min(exp(alpha * mean|len-1|), 1e6)`` so partition cuts avoid
+    regions that still need remeshing; old-interface faces get the flat
+    1e6 boost (metis_pmmg.c:746-843) so previous interfaces fall inside
+    partitions on the next iteration.
+
+    Returns {"pairs": (i, j), "w": weights} aligned with the matched
+    face pairs of the dual graph.
+    """
+    n = len(tet)
+    faces = np.sort(tet[:, [[1, 2, 3], [0, 3, 2], [0, 1, 3], [0, 2, 1]]]
+                    .reshape(n * 4, 3), axis=1)
+    key = (faces[:, 0].astype(np.int64) << 42) | \
+          (faces[:, 1].astype(np.int64) << 21) | faces[:, 2].astype(np.int64)
+    order = np.argsort(key, kind="stable")
+    ks = key[order]
+    same = ks[1:] == ks[:-1]
+    fa, fb = order[:-1][same], order[1:][same]
+    i, j = fa // 4, fb // 4
+    tri = faces[fa]                                   # [m,3] shared face
+    # mean deviation of the 3 face edge metric lengths from 1
+    h = met if met.ndim == 1 else None
+    ev = np.stack([tri[:, [0, 1]], tri[:, [1, 2]], tri[:, [0, 2]]], axis=1)
+    p0 = vert[ev[..., 0]]
+    p1 = vert[ev[..., 1]]
+    d = np.linalg.norm(p1 - p0, axis=-1)
+    if h is not None:
+        hm = 0.5 * (h[ev[..., 0]] + h[ev[..., 1]])
+        L = d / np.maximum(hm, 1e-30)
+    else:  # aniso: use mean of the two endpoint tensor lengths (approx)
+        L = d
+    dev = np.abs(L - 1.0).mean(axis=1)
+    w = np.minimum(np.exp(alpha * dev / 3.0), 1.0e6)
+    if ifc_pairs is not None:
+        mark = np.zeros(n, bool)
+        mark[np.asarray(ifc_pairs[0])] = True
+        boost = mark[i] & mark[j]
+        w = np.where(boost, 1.0e6, w)
+    return {"pairs": (i.astype(np.int64), j.astype(np.int64)), "w": w}
+
+
+def correct_empty_parts(part: np.ndarray, nparts: int,
+                        tet: np.ndarray) -> np.ndarray:
+    """Donate one boundary element to every empty part
+    (PMMG_correct_meshElts2metis, metis_pmmg.c:542-637)."""
+    part = part.copy()
+    counts = np.bincount(part, minlength=nparts)
+    empties = np.where(counts == 0)[0]
+    if len(empties) == 0:
+        return part
+    xadj, adj = build_dual_graph(tet)
+    donors = np.argsort(counts)[::-1]
+    for e in empties:
+        big = donors[0]
+        # pick an element of the big part with a neighbor outside it
+        cand = np.where(part == big)[0]
+        for t in cand:
+            nb = adj[xadj[t]:xadj[t + 1]]
+            if (part[nb] != big).any() or len(nb) < 4:
+                part[t] = e
+                break
+        else:
+            part[cand[0]] = e
+        counts = np.bincount(part, minlength=nparts)
+        donors = np.argsort(counts)[::-1]
+    return part
+
+
+def move_interfaces(tet: np.ndarray, part: np.ndarray, nparts: int,
+                    nlayers: int = 2,
+                    ne_min: int | None = None) -> np.ndarray:
+    """Advancing-front interface displacement
+    (PMMG_part_moveInterfaces, moveinterfaces_pmmg.c:1306-1466): for
+    ``nlayers`` waves, the *larger* part's color advances across the
+    interface into the smaller part (priority = part tet count,
+    PMMG_get_ifcDirection :77-98), by flooding the tet balls of front
+    vertices; a part never shrinks below ``ne_min``
+    (min(6, ne/2+1), :1343).  Returns the displaced partition — old
+    interfaces end up strictly inside the winning part, so the next
+    adaptation can remesh them (the core idea of the iterative
+    remesh-repartition scheme).
+    """
+    n = len(tet)
+    part = part.copy()
+    if ne_min is None:
+        ne_min = min(6, n // (2 * max(nparts, 1)) + 1)
+    nvert = int(tet.max()) + 1
+    for _ in range(nlayers):
+        sizes = np.bincount(part, minlength=nparts).astype(np.int64)
+        # vertex color: the max-priority (larger part wins; ties by id)
+        # among incident tets — the owner-priority merge of the reference
+        pri = sizes[part] * np.int64(nparts) + part     # unique ordering
+        vpri = np.zeros(nvert, np.int64)
+        np.maximum.at(vpri, tet.reshape(-1), np.repeat(pri, 4))
+        vcol = (vpri % nparts).astype(np.int32)
+        # front vertices: incident to ≥2 colors
+        vmin = np.full(nvert, np.int64(1) << 60)
+        np.minimum.at(vmin, tet.reshape(-1), np.repeat(pri, 4))
+        front = vmin != vpri
+        # advance: every tet touching a front vertex whose winning color
+        # differs takes that color (ball flood), respecting ne_min
+        tfront = front[tet].any(axis=1)
+        # winning color per tet = max vertex color priority over corners
+        wpri = vpri[tet].max(axis=1)
+        wcol = (wpri % nparts).astype(np.int32)
+        change = tfront & (wcol != part)
+        # donor-side floor: do not let a part drop below ne_min
+        donors = part[change]
+        loss = np.bincount(donors, minlength=nparts)
+        allowed = sizes - ne_min
+        scale_ok = loss <= np.maximum(allowed, 0)
+        blocked = ~scale_ok[donors]
+        if blocked.any():
+            # keep only as many moves per donor as allowed (first-come)
+            idx = np.where(change)[0]
+            keep = np.ones(len(idx), bool)
+            budget = np.maximum(allowed, 0).copy()
+            for q, t in enumerate(idx):
+                d = part[t]
+                if budget[d] > 0:
+                    budget[d] -= 1
+                else:
+                    keep[q] = False
+            change[:] = False
+            change[idx[keep]] = True
+        part[change] = wcol[change]
+    return fix_contiguity(tet, part)
+
+
+def partition_metrics(tet: np.ndarray, part: np.ndarray,
+                      nparts: int) -> dict:
+    """Edge-cut + imbalance diagnostics (for tests and the LB driver)."""
+    xadj, adj = build_dual_graph(tet)
+    src = np.repeat(np.arange(len(tet)), np.diff(xadj))
+    cut = int((part[src] != part[adj]).sum()) // 2
+    counts = np.bincount(part, minlength=nparts)
+    imb = float(counts.max() / max(1.0, counts.mean()))
+    return {"edge_cut": cut, "imbalance": imb,
+            "counts": counts.tolist()}
+
+
 def fix_contiguity(tet: np.ndarray, part: np.ndarray) -> np.ndarray:
     """Relabel all but the largest connected blob of each color into a
     neighboring color (reference PMMG_fix_contiguity semantics,

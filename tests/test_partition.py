@@ -1,0 +1,92 @@
+"""Partitioner tests: balance, contiguity, weights, interface movement.
+
+The reference's partition quality is implicit in METIS; here we assert the
+properties the remesh-repartition loop actually needs: balance within the
+groups-ratio, contiguity, empty-part repair, and that interface
+displacement actually moves old interfaces into part interiors
+(moveinterfaces_pmmg.c behavior).
+"""
+import numpy as np
+
+from parmmg_tpu.parallel.partition import (
+    morton_partition, greedy_partition, fix_contiguity, build_dual_graph,
+    metric_edge_weights, correct_empty_parts, move_interfaces,
+    partition_metrics)
+from parmmg_tpu.utils.fixtures import cube_mesh
+
+
+def _cube(n=4):
+    vert, tet = cube_mesh(n)
+    cent = vert[tet].mean(axis=1)
+    return vert, tet, cent
+
+
+def test_morton_balanced_contiguous():
+    vert, tet, cent = _cube(4)
+    for nparts in (2, 4, 8):
+        part = fix_contiguity(tet, morton_partition(cent, nparts))
+        m = partition_metrics(tet, part, nparts)
+        assert min(m["counts"]) > 0
+        assert m["imbalance"] < 1.7
+        # contiguity: fix_contiguity is idempotent
+        part2 = fix_contiguity(tet, part)
+        assert (part2 == part).all()
+
+
+def test_greedy_beats_or_matches_morton_cut():
+    vert, tet, cent = _cube(4)
+    pm = morton_partition(cent, 4)
+    pg = greedy_partition(tet, cent, 4)
+    mm = partition_metrics(tet, pm, 4)
+    mg = partition_metrics(tet, pg, 4)
+    assert mg["edge_cut"] <= mm["edge_cut"] * 2.0   # sanity envelope
+    assert min(mg["counts"]) > 0
+
+
+def test_metric_edge_weights_boost():
+    vert, tet, cent = _cube(3)
+    met = np.full(len(vert), 0.33)          # ~unit lengths: low weight
+    w1 = metric_edge_weights(tet, vert, met)
+    met_bad = np.full(len(vert), 0.05)      # everything overlong
+    w2 = metric_edge_weights(tet, vert, met_bad)
+    assert w2["w"].mean() > w1["w"].mean()
+    assert w2["w"].max() <= 1.0e6 + 1e-9
+    # old-interface boost dominates
+    ifc = (np.arange(10), None)
+    w3 = metric_edge_weights(tet, vert, met, ifc_pairs=ifc)
+    pairs_i, pairs_j = w3["pairs"]
+    both = np.isin(pairs_i, ifc[0]) & np.isin(pairs_j, ifc[0])
+    if both.any():
+        assert (w3["w"][both] == 1.0e6).all()
+
+
+def test_correct_empty_parts():
+    vert, tet, cent = _cube(3)
+    part = np.zeros(len(tet), np.int32)     # everything on part 0
+    fixed = correct_empty_parts(part, 4, tet)
+    counts = np.bincount(fixed, minlength=4)
+    assert (counts > 0).all()
+
+
+def test_move_interfaces_displaces_and_keeps_cover():
+    vert, tet, cent = _cube(4)
+    part = fix_contiguity(tet, morton_partition(cent, 4))
+    ifc_before = _interface_verts(tet, part)
+    moved = move_interfaces(tet, part, 4, nlayers=2)
+    counts = np.bincount(moved, minlength=4)
+    assert (counts > 0).all()
+    ifc_after = _interface_verts(tet, moved)
+    # the displaced interface must differ from the old one (old interface
+    # now largely interior)
+    assert len(ifc_before & ifc_after) < len(ifc_before)
+
+
+def _interface_verts(tet, part):
+    xadj, adj = build_dual_graph(tet)
+    src = np.repeat(np.arange(len(tet)), np.diff(xadj))
+    cross = part[src] != part[adj]
+    out = set()
+    # vertices on cut faces: shared verts of the two tets
+    for a, b in zip(src[cross], adj[cross]):
+        out |= set(tet[a]) & set(tet[b])
+    return out
