@@ -66,6 +66,24 @@ def read_mesh(path: str | Path) -> MeditMesh:
     path = Path(path)
     if path.suffix == ".meshb":
         return _read_meshb(path)
+    # native fast path for the common Vertices/Tetrahedra/Triangles case;
+    # files with additional sections fall back to the Python tokenizer
+    try:
+        txt = path.read_text()
+        simple = not any(
+            k in txt for k in ("Edges", "Corners", "Required", "Ridges",
+                               "Parallel", "Normals"))
+        if simple:
+            from .. import native
+            if native.available():
+                got = native.scan_medit(path)
+                m = MeditMesh()
+                m.vert, m.vref = got["vert"], got["vref"]
+                m.tetra, m.tref = got["tet"], got["tref"]
+                m.tria, m.triaref = got["tria"], got["triaref"]
+                return m
+    except Exception:
+        pass
     return _read_mesh_ascii(path)
 
 

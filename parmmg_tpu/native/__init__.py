@@ -1,0 +1,120 @@
+"""ctypes bindings for the native host runtime (meshkit.cpp).
+
+Builds ``libmeshkit.so`` on demand with g++ (no pybind11 in the image —
+plain C ABI + ctypes, per the environment contract).  Every entry point
+has a pure-numpy fallback elsewhere in the package; ``available()`` gates
+use.
+"""
+from __future__ import annotations
+
+import ctypes
+import subprocess
+from pathlib import Path
+
+import numpy as np
+
+_DIR = Path(__file__).parent
+_SO = _DIR / "libmeshkit.so"
+_LIB = None
+
+
+def build(force: bool = False) -> bool:
+    src = _DIR / "meshkit.cpp"
+    if _SO.exists() and not force \
+            and _SO.stat().st_mtime >= src.stat().st_mtime:
+        return True
+    try:
+        subprocess.run(
+            ["g++", "-O3", "-std=c++17", "-shared", "-fPIC",
+             str(src), "-o", str(_SO)],
+            check=True, capture_output=True, timeout=120)
+        return True
+    except Exception:
+        return False
+
+
+def _lib():
+    global _LIB
+    if _LIB is not None:
+        return _LIB
+    if not build():
+        return None
+    lib = ctypes.CDLL(str(_SO))
+    i32p = np.ctypeslib.ndpointer(np.int32, flags="C")
+    i64p = np.ctypeslib.ndpointer(np.int64, flags="C")
+    f64p = np.ctypeslib.ndpointer(np.float64, flags="C")
+    lib.build_adjacency.argtypes = [ctypes.c_int64, i32p, i32p]
+    lib.greedy_partition.argtypes = [ctypes.c_int64, i32p, f64p,
+                                     ctypes.c_int32, i64p, i32p]
+    lib.scan_medit.argtypes = [ctypes.c_char_p, ctypes.c_int64,
+                               ctypes.c_int, i64p,
+                               ctypes.c_void_p, ctypes.c_void_p,
+                               ctypes.c_void_p, ctypes.c_void_p,
+                               ctypes.c_void_p, ctypes.c_void_p]
+    lib.color_components.argtypes = [ctypes.c_int64, i32p, i32p, i32p]
+    lib.color_components.restype = ctypes.c_int
+    _LIB = lib
+    return lib
+
+
+def available() -> bool:
+    return _lib() is not None
+
+
+def build_adjacency(tet: np.ndarray) -> np.ndarray:
+    """adja[4*t+f] = 4*t'+f' or -1 (host fast path)."""
+    lib = _lib()
+    tet = np.ascontiguousarray(tet, np.int32)
+    ne = len(tet)
+    adja = np.empty(4 * ne, np.int32)
+    lib.build_adjacency(ne, tet.reshape(-1), adja)
+    return adja.reshape(ne, 4)
+
+
+def greedy_partition(adja: np.ndarray, nparts: int,
+                     seeds: np.ndarray,
+                     weights: np.ndarray | None = None) -> np.ndarray:
+    lib = _lib()
+    ne = len(adja)
+    w = np.ascontiguousarray(
+        np.ones(ne) if weights is None else weights, np.float64)
+    part = np.empty(ne, np.int32)
+    lib.greedy_partition(ne, np.ascontiguousarray(adja, np.int32)
+                         .reshape(-1), w, nparts,
+                         np.ascontiguousarray(seeds, np.int64), part)
+    return part
+
+
+def scan_medit(path) -> dict:
+    """Fast ASCII Medit scan -> dict of arrays (vert 0-based ids)."""
+    lib = _lib()
+    data = Path(path).read_bytes()
+    counts = np.zeros(3, np.int64)
+    lib.scan_medit(data, len(data), 0, counts, None, None, None, None,
+                   None, None)
+    np_, ne, nt = map(int, counts)
+    vert = np.empty((np_, 3), np.float64)
+    vref = np.empty(np_, np.int32)
+    tet = np.empty((ne, 4), np.int32)
+    tref = np.empty(ne, np.int32)
+    tria = np.empty((max(nt, 1), 3), np.int32)
+    triaref = np.empty(max(nt, 1), np.int32)
+    lib.scan_medit(data, len(data), 1, counts,
+                   vert.ctypes.data_as(ctypes.c_void_p),
+                   vref.ctypes.data_as(ctypes.c_void_p),
+                   tet.ctypes.data_as(ctypes.c_void_p),
+                   tref.ctypes.data_as(ctypes.c_void_p),
+                   tria.ctypes.data_as(ctypes.c_void_p),
+                   triaref.ctypes.data_as(ctypes.c_void_p))
+    return {"vert": vert, "vref": vref, "tet": tet - 1, "tref": tref,
+            "tria": tria[:nt] - 1, "triaref": triaref[:nt]}
+
+
+def color_components(adja: np.ndarray, part: np.ndarray) -> np.ndarray:
+    lib = _lib()
+    ne = len(adja)
+    comp = np.empty(ne, np.int32)
+    lib.color_components(ne, np.ascontiguousarray(adja, np.int32)
+                         .reshape(-1),
+                         np.ascontiguousarray(part, np.int32), comp)
+    return comp

@@ -79,8 +79,28 @@ def build_dual_graph(tet: np.ndarray):
 
 def greedy_partition(tet: np.ndarray, centroids: np.ndarray, nparts: int,
                      weights: np.ndarray | None = None) -> np.ndarray:
-    """BFS graph growing from spread seeds; balanced by element weight."""
+    """BFS graph growing from spread seeds; balanced by element weight.
+
+    Uses the native C++ kernel (native/meshkit.cpp) when available; the
+    numpy path below is the reference implementation and fallback.
+    """
     n = len(tet)
+    try:
+        from .. import native
+        if native.available():
+            c = np.asarray(centroids, np.float64)
+            lo = c.min(axis=0)
+            span = np.maximum(c.max(axis=0) - lo, 1e-30)
+            key = _morton3((c - lo) / span * 0.999999)
+            order = np.argsort(key)
+            seeds = order[np.linspace(0, n - 1, nparts).astype(int)]
+            adja = native.build_adjacency(np.asarray(tet, np.int32))
+            return native.greedy_partition(
+                adja, nparts, seeds.astype(np.int64),
+                None if weights is None
+                else np.asarray(weights, np.float64))
+    except Exception:
+        pass
     xadj, adj = build_dual_graph(tet)
     w = np.ones(n) if weights is None else np.asarray(weights, float)
     target = w.sum() / nparts
