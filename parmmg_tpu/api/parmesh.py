@@ -329,8 +329,12 @@ class ParMesh:
         if self.np_ == 0 or self.ne_ == 0:
             raise ValueError("mesh size not set")
         tets0 = self.tetra - 1                     # 1-based -> 0-based
+        from ..utils.budget import plan_capacities
+        capP, capT = plan_capacities(self.np_, self.ne_,
+                                     self.info.mem_budget_mb)
         mesh = make_mesh(self.vert, tets0.astype(np.int32),
-                         vref=self.vref, tref=self.tref)
+                         vref=self.vref, tref=self.tref,
+                         capP=capP, capT=capT)
         # geometric analysis first (ridges/corners/normals from dihedrals)
         mesh = analyze_mesh(
             mesh, angedg=np.cos(np.deg2rad(self.info.angle_deg))

@@ -56,9 +56,13 @@ def build_metric(mesh: Mesh, met, info):
 def parmmg_run(pm) -> tuple[Mesh, object, AdaptStats]:
     """Run the full adaptation per the staged ParMesh. Returns
     (adapted core Mesh, metric, stats)."""
+    from .utils.timers import Timers
     info = pm.info
-    mesh, met = pm._build_core_mesh()
-    met = build_metric(mesh, met, info)
+    tim = Timers()
+    with tim("analysis"):
+        mesh, met = pm._build_core_mesh()
+    with tim("metric"):
+        met = build_metric(mesh, met, info)
 
     # background snapshot for field interpolation (PMMG_create_oldGrp
     # analogue, grpsplit_pmmg.c:207).  Deep copy: adapt_cycle donates its
@@ -74,10 +78,11 @@ def parmmg_run(pm) -> tuple[Mesh, object, AdaptStats]:
     stats = AdaptStats()
     if info.n_devices <= 1:
         niter = max(1, info.niter)
-        for _ in range(niter):
-            mesh, met, st = adapt_mesh(
-                mesh, met,
-                verbose=3 if info.imprim >= C.PMMG_VERB_ITWAVES else 0)
+        for it in range(niter):
+            with tim(f"adaptation"):
+                mesh, met, st = adapt_mesh(
+                    mesh, met,
+                    verbose=3 if info.imprim >= C.PMMG_VERB_ITWAVES else 0)
             stats += st
     else:
         from .parallel.dist import distributed_adapt
@@ -86,25 +91,55 @@ def parmmg_run(pm) -> tuple[Mesh, object, AdaptStats]:
         part = None
         niter = max(1, info.niter)
         for it in range(niter):
-            mesh, met, part = distributed_adapt(
-                mesh, met, info.n_devices, part=part,
-                verbose=3 if info.imprim >= C.PMMG_VERB_ITWAVES else 0)
-            mesh = analyze_mesh(mesh).mesh
+            with tim("adaptation"):
+                mesh, met, part = distributed_adapt(
+                    mesh, met, info.n_devices, part=part,
+                    verbose=3 if info.imprim >= C.PMMG_VERB_ITWAVES else 0)
+                mesh = analyze_mesh(mesh).mesh
             if it + 1 < niter and not info.nobalancing \
                     and info.repartitioning == C.REPART_IFC_DISPLACEMENT:
                 # displace old interfaces into shard interiors so the
                 # next pass can remesh them (loadbalancing_pmmg.c flow)
-                _, tet_h, _, _, _ = mesh_to_host(mesh)
-                part = move_interfaces(tet_h, part, info.n_devices,
-                                       nlayers=info.ifc_layers)
+                with tim("load balancing"):
+                    _, tet_h, _, _, _ = mesh_to_host(mesh)
+                    part = move_interfaces(tet_h, part, info.n_devices,
+                                           nlayers=info.ifc_layers)
             elif it + 1 < niter:
                 part = None          # fresh graph partition next iter
 
     # interpolate user fields old mesh -> new mesh
     if bg_fields:
-        pm.fields = interpolate_fields(bg_mesh, bg_fields, mesh)
+        with tim("metric and fields interpolation"):
+            pm.fields = interpolate_fields(bg_mesh, bg_fields, mesh)
 
+    if info.imprim >= C.PMMG_VERB_QUAL:
+        print_quality_report(mesh, met, info)
+    if info.imprim >= C.PMMG_VERB_STEPS:
+        print(tim.report())
     return mesh, met, stats
+
+
+def print_quality_report(mesh: Mesh, met, info) -> None:
+    """Quality + edge-length histograms (PMMG_qualhisto OUTQUA +
+    PMMG_prilen, quality_pmmg.c:156,591 — the custom MPI_Op reductions
+    become plain array reductions on the merged mesh / psums on shards)."""
+    import jax.numpy as jnp
+    from .ops.quality import tet_quality, quality_histogram, \
+        length_histogram
+
+    q = tet_quality(mesh, met)
+    counts, qmin, qmean, nbad = quality_histogram(q, mesh.tmask)
+    print(f"  -- MESH QUALITY   {int(jnp.sum(mesh.tmask))} tets ; "
+          f"worst {float(qmin):.6f} ; mean {float(qmean):.6f} ; "
+          f"bad {int(nbad)}")
+    c = np.asarray(counts)
+    for i, n in enumerate(c):
+        lo, hi = i / len(c), (i + 1) / len(c)
+        print(f"     {lo:.1f} < Q < {hi:.1f}   {int(n)}")
+    if met is not None:
+        lc, lmin, lmax, lmean = length_histogram(mesh, met)
+        print(f"  -- EDGE LENGTHS   min {float(lmin):.4f} ; "
+              f"max {float(lmax):.4f} ; mean {float(lmean):.4f}")
 
 
 def interpolate_fields(bg: Mesh, fields: list[np.ndarray], new: Mesh)\

@@ -1,0 +1,34 @@
+"""Memory budgeting: capacity planning under a device-memory cap.
+
+The reference detects available RAM per node, splits it across ranks and
+repartitions the budget over the point/xpoint/tetra/xtetra arrays
+(``PMMG_parsar -m``, zaldy_pmmg.c:53-254).  On TPU the analogue is HBM:
+given a budget in MB, derive the maximum safe array *capacities* (points
+and tets) for the adapt kernels, whose footprint is a known multiple of
+capP/capT (the wave kernels materialize ~6*capT edge slots of int32 plus
+the mesh arrays).
+"""
+from __future__ import annotations
+
+# bytes per capacity slot (fp32 mesh): measured from the Mesh layout +
+# wave-kernel temporaries (edge table + sort buffers dominate)
+BYTES_PER_POINT = 3 * 4 + 4 + 4 + 1 + 4          # vert,vref,vtag,vmask,met
+BYTES_PER_TET = (4 + 1 + 4 + 4 + 4 + 6) * 4 \
+    + 6 * 3 * 4 * 4                               # arrays + edge-table tmp
+
+
+def plan_capacities(n_p: int, n_t: int, budget_mb: int = -1,
+                    headroom: float = 3.0,
+                    device_hbm_mb: int = 16_000) -> tuple[int, int]:
+    """(capP, capT) under the budget; default = 3x growth headroom
+    clamped so the adapt kernels fit in the budget (or HBM)."""
+    budget = (budget_mb if budget_mb > 0 else int(0.6 * device_hbm_mb)) \
+        * 1_000_000
+    capP = int(headroom * n_p)
+    capT = int(headroom * n_t)
+    need = capP * BYTES_PER_POINT + capT * BYTES_PER_TET
+    if need > budget:
+        scale = budget / need
+        capP = max(n_p, int(capP * scale))
+        capT = max(n_t, int(capT * scale))
+    return max(64, capP), max(64, capT)

@@ -1,0 +1,40 @@
+"""Hierarchical wall-clock timers (mytime/chrono/printim analogue).
+
+The reference tracks per-phase times in ``PMMG_ctim[TIMEMAX]`` slots with
+verbosity-gated prints (parmmg.c:35,91; libparmmg1.c:636-948).  Here a
+small nestable timer registry with the same reporting role.
+"""
+from __future__ import annotations
+
+import time
+from contextlib import contextmanager
+
+
+class Timers:
+    def __init__(self):
+        self.acc: dict[str, float] = {}
+        self.count: dict[str, int] = {}
+        self._stack: list[tuple[str, float]] = []
+
+    @contextmanager
+    def __call__(self, name: str):
+        path = "/".join([p for p, _ in self._stack] + [name])
+        t0 = time.perf_counter()
+        self._stack.append((name, t0))
+        try:
+            yield
+        finally:
+            self._stack.pop()
+            dt = time.perf_counter() - t0
+            self.acc[path] = self.acc.get(path, 0.0) + dt
+            self.count[path] = self.count.get(path, 0) + 1
+
+    def report(self, min_s: float = 0.0) -> str:
+        lines = []
+        for k in sorted(self.acc):
+            if self.acc[k] < min_s:
+                continue
+            depth = k.count("/")
+            lines.append(f"{'  ' * depth}{k.split('/')[-1]:28s} "
+                         f"{self.acc[k]:9.3f}s  x{self.count[k]}")
+        return "\n".join(lines)

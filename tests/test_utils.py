@@ -1,0 +1,41 @@
+"""Timers, memory budgeting, debug dumps."""
+import numpy as np
+
+from parmmg_tpu.utils.timers import Timers
+from parmmg_tpu.utils.budget import plan_capacities
+from parmmg_tpu.utils import debug
+from parmmg_tpu.core.mesh import make_mesh
+from parmmg_tpu.ops.analysis import analyze_mesh
+from parmmg_tpu.utils.fixtures import cube_mesh
+
+
+def test_timers_nesting():
+    t = Timers()
+    with t("outer"):
+        with t("inner"):
+            pass
+    assert "outer" in t.acc and "outer/inner" in t.acc
+    assert t.acc["outer"] >= t.acc["outer/inner"]
+    assert "inner" in t.report()
+
+
+def test_plan_capacities_budget():
+    capP, capT = plan_capacities(1000, 6000, budget_mb=-1)
+    assert capP == 3000 and capT == 18000
+    capP2, capT2 = plan_capacities(1000, 6000, budget_mb=1)
+    assert capP2 < capP and capT2 < capT
+    assert capP2 >= 1000 and capT2 >= 6000   # never below content
+
+
+def test_debug_dumps(tmp_path):
+    vert, tet = cube_mesh(2)
+    m = analyze_mesh(make_mesh(vert, tet)).mesh
+    p = debug.dump_mesh(m, tmp_path / "dbg.mesh")
+    assert p.exists() and p.stat().st_size > 0
+    t = debug.dump_tags(m, tmp_path / "tags.txt")
+    txt = t.read_text()
+    assert "CRN" in txt and "BDY" in txt
+    chk = debug.check_mesh_consistency(m)
+    assert chk["asymmetric"] == 0
+    assert chk["nonpositive_vols"] == 0
+    assert chk["dangling_vertex_refs"] == 0
