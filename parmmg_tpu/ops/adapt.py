@@ -68,6 +68,13 @@ def adapt_cycle_impl(mesh: Mesh, met: jax.Array, wave: jax.Array,
     col = collapse_wave(mesh, met)
     mesh = col.mesh
     mesh = build_adjacency(mesh)
+    # collapse rewires the surface (dying tets' face tags transfer to the
+    # surviving neighbors); re-propagate MG_BDY from faces to their edges
+    # and vertices so later splits/smooth treat the new surface entities
+    # as boundary — without this, untagged surface midpoints become
+    # "movable" and smoothing dents the surface
+    from .adjacency import boundary_edge_tags
+    mesh = boundary_edge_tags(mesh)
     ncol = col.ncollapse
 
     nswap = jnp.zeros((), jnp.int32)

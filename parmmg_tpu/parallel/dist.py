@@ -73,6 +73,8 @@ def dist_adapt_cycle(dmesh: DeviceMesh):
         mesh = build_adjacency(mesh)
         col = collapse_wave(mesh, met)
         mesh = build_adjacency(col.mesh)
+        from ..ops.adjacency import boundary_edge_tags
+        mesh = boundary_edge_tags(mesh)      # re-tag rewired surface
         s32 = swap32_wave(mesh, met)
         mesh = build_adjacency(s32.mesh)
         s23 = swap23_wave(mesh, met)

@@ -1,0 +1,77 @@
+"""Anisotropic-metric adaptation tests (the reference's aniso CI cases:
+planar-shock tensor metrics, cmake/testing/pmmg_tests.cmake sphere-aniso).
+"""
+import numpy as np
+import jax.numpy as jnp
+
+from parmmg_tpu.core.mesh import make_mesh, tet_volumes
+from parmmg_tpu.core import constants as C
+from parmmg_tpu.ops.adjacency import build_adjacency, check_adjacency
+from parmmg_tpu.ops.analysis import analyze_mesh
+from parmmg_tpu.ops.adapt import adapt_mesh
+from parmmg_tpu.ops.quality import (
+    tet_quality, edge_length_ani, iso_to_tensor)
+from parmmg_tpu.ops.edges import unique_edges, edge_lengths
+from parmmg_tpu.utils.fixtures import cube_mesh
+
+
+def _cube(n=2, capmul=6):
+    vert, tet = cube_mesh(n)
+    m = make_mesh(vert, tet, capP=capmul * len(vert), capT=capmul * len(tet))
+    return analyze_mesh(m).mesh
+
+
+def test_edge_length_ani_matches_iso_for_isotropic_tensor():
+    p0 = jnp.asarray(np.array([[0.0, 0, 0]]))
+    p1 = jnp.asarray(np.array([[1.0, 0, 0]]))
+    h = jnp.asarray(np.array([0.5]))
+    t = iso_to_tensor(h)
+    from parmmg_tpu.ops.quality import edge_length_iso
+    li = edge_length_iso(p0, p1, h, h)
+    la = edge_length_ani(p0, p1, t, t)
+    assert np.allclose(np.asarray(li), np.asarray(la), rtol=1e-5)
+
+
+def test_aniso_adapt_directional_refinement():
+    m = _cube(2)
+    # metric: tight spacing (0.15) along x, loose (0.6) along y/z
+    hx, hyz = 0.15, 0.6
+    t = np.tile(np.array([1 / hx**2, 0, 0, 1 / hyz**2, 0, 1 / hyz**2]),
+                (m.capP, 1))
+    met = jnp.asarray(t)
+    m2, met2, st = adapt_mesh(m, met, max_cycles=25)
+    assert st.nsplit > 0
+    m2 = build_adjacency(m2)
+    assert check_adjacency(m2) == {"asymmetric": 0, "face_mismatch": 0}
+    vols = np.asarray(tet_volumes(m2))[np.asarray(m2.tmask)]
+    assert (vols > 0).all()
+    assert np.isclose(vols.sum(), 1.0, rtol=1e-4)
+    # directional check: mean edge extent along x much shorter than y/z
+    et = unique_edges(m2)
+    em = np.asarray(et.emask)
+    ev = np.asarray(et.ev)[em]
+    vv = np.asarray(m2.vert)
+    d = np.abs(vv[ev[:, 0]] - vv[ev[:, 1]])
+    assert d[:, 0].mean() < 0.6 * max(d[:, 1].mean(), d[:, 2].mean())
+    # all metric lengths below the split threshold
+    lens = np.asarray(edge_lengths(m2, et, met2))[em]
+    assert lens.max() < C.LLONG + 0.2
+
+
+def test_aniso_api_roundtrip():
+    from parmmg_tpu.api import ParMesh, IParam
+    vert, tet = cube_mesh(2)
+    pm = ParMesh()
+    pm.set_mesh_size(np_=len(vert), ne=len(tet))
+    pm.set_vertices(vert)
+    pm.set_tetrahedra(tet + 1)
+    pm.set_met_size(3, len(vert))
+    t = np.tile(np.array([1 / 0.2**2, 0, 0, 1 / 0.5**2, 0, 1 / 0.5**2]),
+                (len(vert), 1))
+    pm.set_tensor_mets(t)
+    pm.set_iparameter(IParam.niter, 1)
+    assert pm.run() == C.PMMG_SUCCESS
+    v, _ = pm.get_vertices()
+    assert len(v) > len(vert)
+    met = pm.get_metric()
+    assert met.shape[1] == 6
