@@ -94,7 +94,8 @@ def parmmg_run(pm) -> tuple[Mesh, object, AdaptStats]:
             with tim("adaptation"):
                 mesh, met, part = distributed_adapt(
                     mesh, met, info.n_devices, part=part,
-                    verbose=3 if info.imprim >= C.PMMG_VERB_ITWAVES else 0)
+                    verbose=3 if info.imprim >= C.PMMG_VERB_ITWAVES else 0,
+                    stats=stats)
                 mesh = analyze_mesh(mesh).mesh
             if it + 1 < niter and not info.nobalancing \
                     and info.repartitioning == C.REPART_IFC_DISPLACEMENT:

@@ -84,12 +84,18 @@ def unique_edges(mesh: Mesh) -> EdgeTable:
 def edge_lengths(mesh: Mesh, et: EdgeTable, met: jax.Array) -> jax.Array:
     """[capE] metric length of each unique edge (garbage on dead slots)."""
     from .quality import edge_length_iso, edge_length_ani
+    from .pallas_kernels import (use_pallas, edge_length_iso_pallas,
+                                 edge_length_ani_pallas)
     p0 = mesh.vert[jnp.clip(et.ev[:, 0], 0, mesh.capP - 1)]
     p1 = mesh.vert[jnp.clip(et.ev[:, 1], 0, mesh.capP - 1)]
     i0 = jnp.clip(et.ev[:, 0], 0, mesh.capP - 1)
     i1 = jnp.clip(et.ev[:, 1], 0, mesh.capP - 1)
     if met.ndim == 1:
+        if use_pallas():
+            return edge_length_iso_pallas(p0, p1, met[i0], met[i1])
         return edge_length_iso(p0, p1, met[i0], met[i1])
+    if use_pallas():
+        return edge_length_ani_pallas(p0, p1, met[i0], met[i1])
     return edge_length_ani(p0, p1, met[i0], met[i1])
 
 

@@ -1,0 +1,240 @@
+"""Fused Pallas TPU kernels for the per-entity hot math.
+
+The adaptation waves evaluate metric edge lengths and tet qualities for
+every entity every cycle (the vectorized analogue of Mmg's ``MMG5_lenedg``
+/ ``MMG5_caltet`` calls inside ``MMG5_mmg3d1_delone``, which the reference
+invokes per group at /root/reference/src/libparmmg1.c:737-739).  In pure
+XLA each formula materializes a chain of [capE]/[capT] intermediates in
+HBM; these kernels fuse the whole formula into one VMEM pass per block —
+one HBM read per operand, one write per result, all math on the VPU.
+
+Layout: 1-D entity arrays are padded and viewed as [R, 128] (lane dim =
+128), blocked (8, 128) per grid step — the float32 min tile.  Gathers
+(vertex coords by index) stay outside in XLA, which already batches them;
+the kernels are pure elementwise fusion, so they are exact drop-ins.
+
+On non-TPU backends the same kernels run with ``interpret=True`` in tests
+(parity is asserted against the jnp reference in tests/test_pallas.py);
+production dispatch (ops/quality.py, ops/edges.py) uses them only on TPU.
+"""
+from __future__ import annotations
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.constants import ALPHA_TET, EPSD
+
+try:  # pallas is part of jax, but guard exotic builds
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu  # noqa: F401
+    HAVE_PALLAS = True
+except Exception:  # pragma: no cover
+    HAVE_PALLAS = False
+
+_LANE = 128
+_SUB = 8
+_BLOCK = _SUB * _LANE
+
+
+def use_pallas() -> bool:
+    """Production gate: real TPU backend, unless overridden."""
+    env = os.environ.get("PARMMG_TPU_PALLAS", "")
+    if env == "0":
+        return False
+    if env == "1":
+        return True
+    try:
+        return HAVE_PALLAS and jax.default_backend() == "tpu"
+    except Exception:  # pragma: no cover
+        return False
+
+
+def _pad_rows(n: int) -> int:
+    """Rows of a [R,128] view holding n elements, R a multiple of 8."""
+    r = -(-n // _LANE)
+    return -(-r // _SUB) * _SUB
+
+
+def _to_blocks(a: jax.Array, rows: int) -> jax.Array:
+    """[n] -> [rows,128] zero-padded float32 view."""
+    n = a.shape[0]
+    flat = jnp.zeros(rows * _LANE, jnp.float32).at[:n].set(
+        a.astype(jnp.float32))
+    return flat.reshape(rows, _LANE)
+
+
+def _from_blocks(b: jax.Array, n: int, dtype) -> jax.Array:
+    return b.reshape(-1)[:n].astype(dtype)
+
+
+# ---------------------------------------------------------------------------
+# Edge length (iso): exact log-mean integral of 1/h along the edge
+# (numerics identical to ops/quality.py:edge_length_iso)
+# ---------------------------------------------------------------------------
+def _len_iso_kernel(x0, y0, z0, x1, y1, z1, h0, h1, out):
+    dx = x1[:] - x0[:]
+    dy = y1[:] - y0[:]
+    dz = z1[:] - z0[:]
+    d = jnp.sqrt(jnp.maximum(dx * dx + dy * dy + dz * dz, 0.0))
+    ha = jnp.maximum(h0[:], EPSD)
+    hb = jnp.maximum(h1[:], EPSD)
+    r0 = 1.0 / ha
+    r1 = 1.0 / hb
+    close = jnp.abs(r0 - r1) < 1e-6 * jnp.maximum(r0, r1)
+    ratio = jnp.where(close, 1.0, ha / hb)
+    logr = jnp.log(jnp.maximum(ratio, EPSD))
+    lm = jnp.where(close, 0.5 * (r0 + r1),
+                   (r1 - r0) / jnp.where(close, 1.0, logr))
+    out[:] = d * lm
+
+
+def _auto_interpret(interpret: bool | None) -> bool:
+    """interpret=None -> run compiled on TPU, interpreted elsewhere."""
+    if interpret is None:
+        return jax.default_backend() != "tpu"
+    return interpret
+
+
+def edge_length_iso_pallas(p0: jax.Array, p1: jax.Array,
+                           h0: jax.Array, h1: jax.Array,
+                           interpret: bool | None = None) -> jax.Array:
+    """Fused iso edge length. p0,p1: [N,3]; h0,h1: [N] -> [N]."""
+    n = p0.shape[0]
+    rows = _pad_rows(n)
+    args = [_to_blocks(p0[:, 0], rows), _to_blocks(p0[:, 1], rows),
+            _to_blocks(p0[:, 2], rows), _to_blocks(p1[:, 0], rows),
+            _to_blocks(p1[:, 1], rows), _to_blocks(p1[:, 2], rows),
+            _to_blocks(h0, rows), _to_blocks(h1, rows)]
+    spec = pl.BlockSpec((_SUB, _LANE), lambda i: (i, 0))
+    out = pl.pallas_call(
+        _len_iso_kernel,
+        out_shape=jax.ShapeDtypeStruct((rows, _LANE), jnp.float32),
+        grid=(rows // _SUB,),
+        in_specs=[spec] * 8,
+        out_specs=spec,
+        interpret=_auto_interpret(interpret),
+    )(*args)
+    return _from_blocks(out, n, p0.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Edge length (aniso): endpoint quadratic forms + simpson-like average
+# (numerics identical to ops/quality.py:edge_length_ani)
+# ---------------------------------------------------------------------------
+def _len_ani_kernel(ex, ey, ez, a11, a12, a13, a22, a23, a33,
+                    b11, b12, b13, b22, b23, b33, out):
+    x, y, z = ex[:], ey[:], ez[:]
+
+    def quad(m11, m12, m13, m22, m23, m33):
+        return (m11[:] * x * x + m22[:] * y * y + m33[:] * z * z
+                + 2.0 * (m12[:] * x * y + m13[:] * x * z + m23[:] * y * z))
+
+    q0 = quad(a11, a12, a13, a22, a23, a33)
+    q1 = quad(b11, b12, b13, b22, b23, b33)
+    l0 = jnp.sqrt(jnp.maximum(q0, 0.0))
+    l1 = jnp.sqrt(jnp.maximum(q1, 0.0))
+    s = jnp.maximum(l0 + l1, EPSD)
+    out[:] = (2.0 / 3.0) * (l0 * l0 + l0 * l1 + l1 * l1) / s
+
+
+def edge_length_ani_pallas(p0: jax.Array, p1: jax.Array,
+                           m0: jax.Array, m1: jax.Array,
+                           interpret: bool | None = None) -> jax.Array:
+    """Fused aniso edge length. p0,p1: [N,3]; m0,m1: [N,6] -> [N]."""
+    n = p0.shape[0]
+    rows = _pad_rows(n)
+    e = p1 - p0
+    args = [_to_blocks(e[:, k], rows) for k in range(3)]
+    args += [_to_blocks(m0[:, k], rows) for k in range(6)]
+    args += [_to_blocks(m1[:, k], rows) for k in range(6)]
+    spec = pl.BlockSpec((_SUB, _LANE), lambda i: (i, 0))
+    out = pl.pallas_call(
+        _len_ani_kernel,
+        out_shape=jax.ShapeDtypeStruct((rows, _LANE), jnp.float32),
+        grid=(rows // _SUB,),
+        in_specs=[spec] * 15,
+        out_specs=spec,
+        interpret=_auto_interpret(interpret),
+    )(*args)
+    return _from_blocks(out, n, p0.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Tet quality: volume + 6 edge lengths + normalization in one pass
+# (numerics identical to ops/quality.py:quality_from_points)
+# ---------------------------------------------------------------------------
+def _qual_kernel(x0, y0, z0, x1, y1, z1, x2, y2, z2, x3, y3, z3,
+                 m11, m12, m13, m22, m23, m33, out, *, aniso: bool):
+    d1x = x1[:] - x0[:]
+    d1y = y1[:] - y0[:]
+    d1z = z1[:] - z0[:]
+    d2x = x2[:] - x0[:]
+    d2y = y2[:] - y0[:]
+    d2z = z2[:] - z0[:]
+    d3x = x3[:] - x0[:]
+    d3y = y3[:] - y0[:]
+    d3z = z3[:] - z0[:]
+    cx = d2y * d3z - d2z * d3y
+    cy = d2z * d3x - d2x * d3z
+    cz = d2x * d3y - d2y * d3x
+    vol = (d1x * cx + d1y * cy + d1z * cz) / 6.0
+
+    xs = (x0[:], x1[:], x2[:], x3[:])
+    ys = (y0[:], y1[:], y2[:], y3[:])
+    zs = (z0[:], z1[:], z2[:], z3[:])
+    if aniso:
+        M11, M12, M13 = m11[:], m12[:], m13[:]
+        M22, M23, M33 = m22[:], m23[:], m33[:]
+    rap = jnp.zeros_like(vol)
+    # IARE order: (0,1)(0,2)(0,3)(1,2)(1,3)(2,3)
+    for (i, j) in ((0, 1), (0, 2), (0, 3), (1, 2), (1, 3), (2, 3)):
+        ex = xs[j] - xs[i]
+        ey = ys[j] - ys[i]
+        ez = zs[j] - zs[i]
+        if aniso:
+            rap = rap + (M11 * ex * ex + M22 * ey * ey + M33 * ez * ez
+                         + 2.0 * (M12 * ex * ey + M13 * ex * ez
+                                  + M23 * ey * ez))
+        else:
+            rap = rap + ex * ex + ey * ey + ez * ez
+    if aniso:
+        det = (M11 * (M22 * M33 - M23 * M23)
+               - M12 * (M12 * M33 - M23 * M13)
+               + M13 * (M12 * M23 - M22 * M13))
+        num = ALPHA_TET * vol * jnp.sqrt(jnp.maximum(det, 0.0))
+    else:
+        num = ALPHA_TET * vol
+    q = num / jnp.maximum(rap, EPSD) ** 1.5
+    out[:] = jnp.where(vol > 0, jnp.minimum(q, 1.0), jnp.minimum(q, 0.0))
+
+
+def quality_pallas(p: jax.Array, m6bar: jax.Array | None = None,
+                   interpret: bool | None = None) -> jax.Array:
+    """Fused tet quality. p: [N,4,3]; m6bar: optional [N,6] mean metric."""
+    n = p.shape[0]
+    rows = _pad_rows(n)
+    args = []
+    for c in range(4):
+        for k in range(3):
+            args.append(_to_blocks(p[:, c, k], rows))
+    aniso = m6bar is not None
+    if aniso:
+        for k in range(6):
+            args.append(_to_blocks(m6bar[:, k], rows))
+    else:
+        zero = jnp.zeros((rows, _LANE), jnp.float32)
+        args += [zero] * 6
+    spec = pl.BlockSpec((_SUB, _LANE), lambda i: (i, 0))
+    out = pl.pallas_call(
+        functools.partial(_qual_kernel, aniso=aniso),
+        out_shape=jax.ShapeDtypeStruct((rows, _LANE), jnp.float32),
+        grid=(rows // _SUB,),
+        in_specs=[spec] * 18,
+        out_specs=spec,
+        interpret=_auto_interpret(interpret),
+    )(*args)
+    return _from_blocks(out, n, p.dtype)

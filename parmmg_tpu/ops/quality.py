@@ -130,6 +130,13 @@ def tet_quality(mesh: Mesh, met: jax.Array | None = None) -> jax.Array:
     metric, matching MMG5_caltet_iso); aniso path measures volume and edge
     lengths in the average tet metric (MMG5_caltet_ani semantics).
     """
+    from .pallas_kernels import use_pallas, quality_pallas
+    if use_pallas():
+        p = mesh.vert[mesh.tet]                         # [T,4,3]
+        m6bar = None if (met is None or met.ndim == 1) \
+            else jnp.mean(met[mesh.tet], axis=1)
+        q = quality_pallas(p, m6bar)
+        return jnp.where(mesh.tmask, q, 0.0)
     vol = tet_volumes(mesh)
     ev = tet_edge_vertices(mesh.tet)
     e = mesh.vert[ev[..., 1]] - mesh.vert[ev[..., 0]]   # [T,6,3]

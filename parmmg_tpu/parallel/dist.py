@@ -122,7 +122,7 @@ def dist_quality(dmesh: DeviceMesh):
 def distributed_adapt(mesh: Mesh, met, n_shards: int,
                       cycles: int = 10, dmesh: DeviceMesh | None = None,
                       partitioner: str = "morton", verbose: int = 0,
-                      part: np.ndarray | None = None):
+                      part: np.ndarray | None = None, stats=None):
     """One outer remesh pass on n_shards devices (host driver).
 
     partition (or take the caller's displaced ``part``) -> freeze
@@ -162,6 +162,12 @@ def distributed_adapt(mesh: Mesh, met, n_shards: int,
         stacked, met_s, counts, ovf = step(stacked, met_s,
                                            jnp.asarray(c, jnp.int32))
         cs = np.asarray(counts)
+        if stats is not None:          # psum'd global counters -> AdaptStats
+            stats.nsplit += int(cs[0])
+            stats.ncollapse += int(cs[1])
+            stats.nswap += int(cs[2])
+            stats.nmoved += int(cs[3])
+            stats.cycles += 1
         if verbose >= 3:
             print(f"  dist cycle {c}: split {cs[0]} collapse {cs[1]} "
                   f"swap {cs[2]} move {cs[3]}")

@@ -9,8 +9,7 @@ from parmmg_tpu.core import constants as C
 from parmmg_tpu.ops.adjacency import build_adjacency, check_adjacency
 from parmmg_tpu.ops.analysis import analyze_mesh
 from parmmg_tpu.ops.adapt import adapt_mesh
-from parmmg_tpu.ops.quality import (
-    tet_quality, edge_length_ani, iso_to_tensor)
+from parmmg_tpu.ops.quality import edge_length_ani, iso_to_tensor
 from parmmg_tpu.ops.edges import unique_edges, edge_lengths
 from parmmg_tpu.utils.fixtures import cube_mesh
 

@@ -24,10 +24,12 @@ def _setup(n=3, capmul=4):
     return m, jnp.full(m.capP, 0.3, m.vert.dtype)
 
 
-@pytest.mark.parametrize("ndev", [2, 4, 8])
+@pytest.mark.parametrize("ndev", [2, 8])
 def test_distributed_adapt_conforming(ndev):
+    # ndev=4 is covered by the iterated + API tests below; the 1-core CI
+    # host makes each extra (ndev, shape) combo cost minutes of wall clock
     m, met = _setup(3)
-    out, met2, part = distributed_adapt(m, met, ndev, cycles=6)
+    out, met2, part = distributed_adapt(m, met, ndev, cycles=4)
     out = build_adjacency(out)
     assert check_adjacency(out) == {"asymmetric": 0, "face_mismatch": 0}
     vols = np.asarray(tet_volumes(out))[np.asarray(out.tmask)]
@@ -41,7 +43,7 @@ def test_iterated_with_interface_displacement():
     m, met = _setup(3)
     part = None
     for it in range(2):
-        m, met, part = distributed_adapt(m, met, 4, cycles=5, part=part)
+        m, met, part = distributed_adapt(m, met, 4, cycles=3, part=part)
         m = analyze_mesh(m).mesh
         _, tet_h, _, _, _ = mesh_to_host(m)
         part = move_interfaces(tet_h, part, 4, nlayers=2)
