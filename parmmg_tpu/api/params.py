@@ -68,6 +68,7 @@ class Info:
     imprim: int = 1
     mmg_imprim: int = -1
     debug: bool = False
+    mmg_debug: bool = False
     # iteration control (defaults: API_functions_pmmg.c:400-426)
     niter: int = C.NITER_DEFAULT
     nobalancing: bool = False

@@ -317,6 +317,56 @@ class ParMesh:
                 return False
         return True
 
+    def check_set_face_communicators(self) -> bool:
+        """Face-comm mirror of the check above
+        (PMMG_Check_Set_FaceCommunicators, libparmmg.h:2279-2346 flavor):
+        every item set, local triangle ids in range."""
+        for c in self.face_comms:
+            if c["local"] is None:
+                return False
+            lo = np.asarray(c["local"])
+            ntri = self.nt_ if self.tria is None \
+                else max(self.nt_, len(self.tria))
+            if (lo < 1).any() or (lo > ntri).any():
+                return False
+        return True
+
+    def get_node_communicator_owners(self):
+        """Owner rank of each node-comm item + its global id
+        (PMMG_Get_NodeCommunicator_owners semantics: owner = max rank
+        touching the entity, libparmmg.c:962-973).  Returns
+        (owners_per_comm, globals_per_comm, nunique, ntot)."""
+        owners, globs = [], []
+        ntot = 0
+        seen = set()
+        for c in self.node_comms:
+            n = 0 if c["local"] is None else len(c["local"])
+            own = np.full(n, max(self.myrank, int(c["color_out"])), np.int64)
+            owners.append(own)
+            g = (np.zeros(n, np.int64) if c["global_"] is None
+                 else np.asarray(c["global_"], np.int64))
+            globs.append(g)
+            ntot += n
+            seen.update(int(x) for x in g)
+        return owners, globs, len(seen), ntot
+
+    def get_face_communicator_owners(self):
+        """Face-comm mirror of the owners query.  Interface faces are
+        shared by exactly 2 ranks; owner = max of the pair."""
+        owners, globs = [], []
+        ntot = 0
+        seen = set()
+        for c in self.face_comms:
+            n = 0 if c["local"] is None else len(c["local"])
+            own = np.full(n, max(self.myrank, int(c["color_out"])), np.int64)
+            owners.append(own)
+            g = (np.zeros(n, np.int64) if c["global_"] is None
+                 else np.asarray(c["global_"], np.int64))
+            globs.append(g)
+            ntot += n
+            seen.update(int(x) for x in g)
+        return owners, globs, len(seen), ntot
+
     # ------------------------------------------------------------------
     # run
     # ------------------------------------------------------------------

@@ -61,6 +61,10 @@ def build_parser() -> argparse.ArgumentParser:
       help="ridge detection angle (deg)")
     a("-nr", dest="noridge", action="store_true",
       help="no ridge detection")
+    a("-A", dest="aniso", action="store_true",
+      help="anisotropic metric computation (reference -A flag)")
+    a("-mmg-d", dest="mmg_debug", action="store_true",
+      help="remesh-kernel debug mode")
     a("-optim", action="store_true", help="preserve current sizing")
     a("-optimLES", action="store_true")
     a("-noinsert", action="store_true")
@@ -180,6 +184,8 @@ def main(argv=None) -> int:
     info.angle_detection = not args.noridge
     info.optim = args.optim
     info.optimLES = args.optimLES
+    info.anisosize = args.aniso
+    info.mmg_debug = args.mmg_debug
     info.noinsert = args.noinsert
     info.noswap = args.noswap
     info.nomove = args.nomove

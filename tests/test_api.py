@@ -148,3 +148,38 @@ def test_node_communicator_api_roundtrip():
     # sorted by global id per the ordering contract
     assert pm.get_ith_node_communicator_nodes(0).tolist() == [1, 2, 3, 4]
     assert pm.check_set_node_communicators()
+
+
+def test_face_communicator_api_and_owners():
+    pm = ParMesh(nprocs=3, myrank=1)
+    pm.set_mesh_size(np_=8, ne=6, nt=4)
+    pm.set_number_of_face_communicators(2)
+    pm.set_ith_face_communicator_size(0, color_out=0, nitem=2)
+    pm.set_ith_face_communicator_faces(0, [2, 1], [20, 10],
+                                       is_not_ordered=True)
+    pm.set_ith_face_communicator_size(1, color_out=2, nitem=1)
+    pm.set_ith_face_communicator_faces(1, [3], [30], is_not_ordered=False)
+    assert pm.get_number_of_face_communicators() == 2
+    assert pm.get_ith_face_communicator_faces(0).tolist() == [1, 2]
+    assert pm.check_set_face_communicators()
+    owners, globs, nuniq, ntot = pm.get_face_communicator_owners()
+    # owner = max rank of the sharing pair (libparmmg.c:962-973 rule)
+    assert owners[0].tolist() == [1, 1]      # pair (1,0) -> 1
+    assert owners[1].tolist() == [2]         # pair (1,2) -> 2
+    assert (nuniq, ntot) == (3, 3)
+    # out-of-range local id must fail the check
+    pm.set_ith_face_communicator_faces(1, [99], [30], is_not_ordered=False)
+    assert not pm.check_set_face_communicators()
+
+
+def test_node_communicator_owners():
+    pm = ParMesh(nprocs=2, myrank=0)
+    pm.set_mesh_size(np_=8, ne=6)
+    pm.set_number_of_node_communicators(1)
+    pm.set_ith_node_communicator_size(0, color_out=1, nitem=4)
+    pm.set_ith_node_communicator_nodes(
+        0, [3, 1, 4, 2], [30, 10, 40, 20], is_not_ordered=True)
+    owners, globs, nuniq, ntot = pm.get_node_communicator_owners()
+    assert owners[0].tolist() == [1, 1, 1, 1]
+    assert globs[0].tolist() == [10, 20, 30, 40]
+    assert (nuniq, ntot) == (4, 4)
