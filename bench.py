@@ -53,9 +53,20 @@ def _ensure_reachable_backend(probe_timeout_s: int = 240) -> None:
 
 
 def main() -> None:
+    # persistent compile cache: the adapt-cycle graph takes minutes to
+    # compile cold; cached executables make repeated bench runs start fast
+    _cache = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          ".jax_cache")
+    os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", _cache)
+    os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
     _ensure_reachable_backend()
     import jax
     import jax.numpy as jnp
+    jax.config.update("jax_compilation_cache_dir",
+                      os.environ["JAX_COMPILATION_CACHE_DIR"])
+    jax.config.update(
+        "jax_persistent_cache_min_compile_time_secs",
+        float(os.environ["JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS"]))
 
     from parmmg_tpu.core.mesh import make_mesh
     from parmmg_tpu.ops.adapt import adapt_cycle

@@ -57,7 +57,7 @@ def face_normals(mesh: Mesh) -> jax.Array:
     return jnp.cross(p[:, :, 1] - p[:, :, 0], p[:, :, 2] - p[:, :, 0])
 
 
-def analyze_mesh(mesh: Mesh, angedg: float = ANGEDG) -> AnalysisResult:
+def analyze_mesh_impl(mesh: Mesh, angedg: float = ANGEDG) -> AnalysisResult:
     """Run the full sequential surface analysis; jittable.
 
     Expects/It (re)builds adjacency, then derives all geometric entity tags
@@ -165,3 +165,10 @@ def analyze_mesh(mesh: Mesh, angedg: float = ANGEDG) -> AnalysisResult:
 
     out = dataclasses.replace(mesh, etag=etag, vtag=vtag)
     return AnalysisResult(out, vn)
+
+
+# Always jitted: eager dispatch of the ~200-op analysis graph is
+# catastrophic over a remote-device transport (one RPC per op); under jit
+# it is one compiled executable (cached persistently).  jit-of-jit at the
+# call sites inside other jitted code simply inlines.
+analyze_mesh = jax.jit(analyze_mesh_impl)
