@@ -77,11 +77,15 @@ class ParMesh:
         self.n_face_comm = 0
         self.node_comms: list[dict] = []
         self.face_comms: list[dict] = []
-        # outputs
+        # outputs (+ caches, invalidated by run())
         self._out = None                        # core Mesh after run()
         self._out_met = None
         self._out_stats = None
         self._glonum = None
+        self._out_vn = None
+        self._out_host_cache = None
+        self._out_edges_cache = None
+        self._out_tria_cache = None
 
     # ------------------------------------------------------------------
     # sizes
@@ -106,7 +110,11 @@ class ParMesh:
         self.edge_ridge = _grow(self.edge_ridge, na, None, bool)
         self.edge_req = _grow(self.edge_req, na, None, bool)
         self.prism = _grow(self.prism, nprism, 6, np.int64)
+        self.prism_ref = _grow(getattr(self, "prism_ref", None), nprism,
+                               None, np.int32)
         self.quad = _grow(self.quad, nquad, 4, np.int64)
+        self.quad_ref = _grow(getattr(self, "quad_ref", None), nquad,
+                              None, np.int32)
 
     def get_mesh_size(self):
         """PMMG_Get_meshSize."""
@@ -159,9 +167,11 @@ class ParMesh:
 
     def set_prism(self, vs, ref: int, pos: int) -> None:
         self.prism[pos - 1] = vs
+        self.prism_ref[pos - 1] = ref
 
     def set_quadrilateral(self, vs, ref: int, pos: int) -> None:
         self.quad[pos - 1] = vs
+        self.quad_ref[pos - 1] = ref
 
     def set_corner(self, pos: int) -> None:
         self.vcrn[pos - 1] = True
@@ -396,6 +406,35 @@ class ParMesh:
         vtag[: self.np_][self.vcrn] |= C.MG_CRN
         mesh = dataclasses.replace(mesh, vtag=jnp.asarray(vtag))
 
+        # prism/quadrilateral vertices are frozen (Mmg keeps hybrid
+        # elements untouched; their vertices must survive adaptation so
+        # the pass-through connectivity stays valid)
+        hybrid = np.concatenate([
+            (self.prism.reshape(-1) if self.nprism_ else
+             np.zeros(0, np.int64)),
+            (self.quad.reshape(-1) if self.nquad_ else
+             np.zeros(0, np.int64))])
+        if len(hybrid):
+            vtag = np.array(np.asarray(mesh.vtag), copy=True)
+            vtag[(hybrid - 1).astype(np.int64)] |= C.MG_REQ
+            mesh = dataclasses.replace(mesh, vtag=jnp.asarray(vtag))
+
+        # required tetrahedra: freeze all their entities (faces, edges,
+        # vertices get MG_REQ) so no wave touches them — the contract the
+        # remesh kernels honor (same mechanism as the MG_PARBDY freeze)
+        if self.tetra_req is not None and self.tetra_req.any():
+            req = np.flatnonzero(self.tetra_req)
+            ftag = np.array(np.asarray(mesh.ftag), copy=True)
+            etag = np.array(np.asarray(mesh.etag), copy=True)
+            vtag = np.array(np.asarray(mesh.vtag), copy=True)
+            ftag[req] |= C.MG_REQ
+            etag[req] |= C.MG_REQ
+            tv = np.asarray(mesh.tet)[req]
+            vtag[tv.reshape(-1)] |= C.MG_REQ
+            mesh = dataclasses.replace(
+                mesh, ftag=jnp.asarray(ftag), etag=jnp.asarray(etag),
+                vtag=jnp.asarray(vtag))
+
         # user triangles: push refs onto matching boundary faces
         if self.nt_:
             mesh = self._apply_user_triangles(mesh)
@@ -481,6 +520,12 @@ class ParMesh:
         except MemoryError:
             return C.PMMG_STRONGFAILURE
         self._out, self._out_met, self._out_stats = out, met, stats
+        # invalidate all output caches
+        self._glonum = None
+        self._out_vn = None
+        self._out_host_cache = None
+        self._out_edges_cache = None
+        self._out_tria_cache = None
         return C.PMMG_SUCCESS
 
     # ------------------------------------------------------------------
@@ -490,7 +535,12 @@ class ParMesh:
         from ..core.mesh import mesh_to_host
         if self._out is None:
             raise RuntimeError("run() first")
-        return mesh_to_host(self._out)
+        # cached: the single-entity getters (get_vertex/tetrahedron/...)
+        # are naturally called in a loop over all entities; recomputing
+        # the O(N) compaction per call would make that O(N^2)
+        if self._out_host_cache is None:
+            self._out_host_cache = mesh_to_host(self._out)
+        return self._out_host_cache
 
     def _out_ntria(self) -> int:
         m = self._out
@@ -509,15 +559,7 @@ class ParMesh:
     def get_triangles(self):
         """Boundary faces of the adapted mesh as (tria [nt,3] 1-based,
         refs)."""
-        from ..core.mesh import tet_face_vertices, mesh_to_host
-        m = self._out
-        vm = np.asarray(m.vmask)
-        new_id = np.cumsum(vm) - 1
-        fv = np.asarray(tet_face_vertices(m.tet))
-        ftag = np.asarray(m.ftag)
-        sel = ((ftag & C.MG_BDY) != 0) & np.asarray(m.tmask)[:, None]
-        tris = new_id[fv[sel]] + 1
-        refs = np.asarray(m.fref)[sel]
+        tris, refs, _, _ = self._out_triangles()
         return tris, refs
 
     def get_metric(self):
@@ -526,6 +568,165 @@ class ParMesh:
         m = np.asarray(self._out_met)
         vm = np.asarray(self._out.vmask)
         return m[vm]
+
+    # -- single-entity getters (PMMG_Get_vertex/tetrahedron/triangle/edge,
+    #    API_functions_pmmg.c; flags decoded from the MG_* tag bits) -------
+    def get_vertex(self, pos: int):
+        """(x, y, z, ref, isCorner, isRequired) of output vertex `pos`."""
+        vert, _, vref, _, vtag = self._out_host()
+        t = int(vtag[pos - 1])
+        return (*map(float, vert[pos - 1]), int(vref[pos - 1]),
+                bool(t & C.MG_CRN), bool(t & C.MG_REQ))
+
+    def get_tetrahedron(self, pos: int):
+        """(v0..v3 1-based, ref, isRequired).
+
+        isRequired is derived from the freeze marker (all 4 faces
+        MG_REQ), the mechanism ``set_required_tetrahedron`` uses; a tet
+        whose 4 faces were all independently marked required via user
+        triangles reads back as required too (the flat mesh carries no
+        separate per-tet flag)."""
+        _, tet, _, tref, _ = self._out_host()
+        m = self._out
+        ftag = np.asarray(m.ftag)[np.asarray(m.tmask)]
+        req = bool((ftag[pos - 1] & C.MG_REQ).all())
+        return tuple(int(v) + 1 for v in tet[pos - 1]) + \
+            (int(tref[pos - 1]), req)
+
+    def get_triangle(self, pos: int):
+        """(v0..v2 1-based, ref, isRequired) of output boundary tria."""
+        tris, refs, req, _ = self._out_triangles()
+        return tuple(int(v) for v in tris[pos - 1]) + \
+            (int(refs[pos - 1]), bool(req[pos - 1]))
+
+    def get_edges(self):
+        """Feature edges (ridge/ref/required) of the adapted mesh:
+        (edges [na,2] 1-based, refs, isRidge, isRequired).  The reference
+        rebuilds the edge list from xtetra tags at output
+        (MMG3D bdryBuild path); here it is one masked unique over the
+        per-tet edge tag array.  Edge refs: staged user refs are carried
+        only for edges whose endpoints are original staged vertices
+        (midpoints inserted on a refined ref-edge lose the numeric ref —
+        tracked gap, the MG_REF flag itself is preserved)."""
+        if self._out_edges_cache is not None:
+            return self._out_edges_cache
+        from ..core.mesh import tet_edge_vertices
+        m = self._out
+        ev = np.asarray(tet_edge_vertices(m.tet)).reshape(-1, 2)
+        etag = np.asarray(m.etag).reshape(-1)
+        live = np.repeat(np.asarray(m.tmask), 6)
+        feat = live & ((etag & (C.MG_GEO | C.MG_REQ | C.MG_REF)) != 0)
+        e = np.sort(ev[feat], axis=1)
+        tags = etag[feat]
+        key = e[:, 0].astype(np.int64) << 32 | e[:, 1]
+        o = np.argsort(key, kind="stable")
+        key, e, tags = key[o], e[o], tags[o]
+        head = np.concatenate([[True], key[1:] != key[:-1]])
+        seg = np.cumsum(head) - 1
+        # OR tags over duplicate tet-edge slots of the same edge
+        utags = np.zeros(int(head.sum()), np.uint32)
+        np.bitwise_or.at(utags, seg, tags.astype(np.uint32))
+        e = e[head]
+        vmask = np.asarray(m.vmask)
+        new_id = np.cumsum(vmask) - 1
+        # recover staged user edge refs where both endpoints are original
+        # staged vertices (1-based output ids of staged vertex i = its
+        # compacted position; staged vertices occupy the leading rows)
+        refs = np.zeros(len(e), np.int32)
+        if self.na_ and len(e):
+            out_e = new_id[e]                       # 0-based output ids
+            orig = (e < self.np_).all(axis=1)       # original-vertex rows
+            ue = np.sort(self.edge - 1, axis=1)
+            ukey = ue[:, 0].astype(np.int64) << 32 | ue[:, 1]
+            ekey = np.sort(e, axis=1)
+            ekey = ekey[:, 0].astype(np.int64) << 32 | ekey[:, 1]
+            o = np.argsort(ukey)
+            pos = np.clip(np.searchsorted(ukey[o], ekey), 0, len(ukey) - 1)
+            hit = orig & (ukey[o][pos] == ekey)
+            refs[hit] = self.edgeref[o][pos[hit]]
+        self._out_edges_cache = (
+            new_id[e] + 1, refs,
+            (utags & C.MG_GEO) != 0, (utags & C.MG_REQ) != 0)
+        return self._out_edges_cache
+
+    def get_edge(self, pos: int):
+        """(v0, v1 1-based, ref, isRidge, isRequired)."""
+        e, r, rid, req = self.get_edges()
+        return (int(e[pos - 1, 0]), int(e[pos - 1, 1]), int(r[pos - 1]),
+                bool(rid[pos - 1]), bool(req[pos - 1]))
+
+    def _input_vertex_remap(self):
+        """Output 1-based id of each staged input vertex (vertices are
+        frozen only if tagged; callers use this for pass-through hybrid
+        elements whose vertices ARE frozen)."""
+        if self._out is None:
+            return None
+        vm = np.asarray(self._out.vmask)
+        new_id = np.cumsum(vm) - 1
+        return new_id[: self.np_] + 1
+
+    def get_prisms(self):
+        """Prisms pass through adaptation untouched (their vertices are
+        frozen at run(); PMMG_Get_prisms).  Connectivity is renumbered to
+        the output vertex ids."""
+        if self._out is not None and self.nprism_:
+            rm = self._input_vertex_remap()
+            return rm[self.prism - 1], self.prism_ref
+        return self.prism, self.prism_ref
+
+    def get_quadrilaterals(self):
+        if self._out is not None and self.nquad_:
+            rm = self._input_vertex_remap()
+            return rm[self.quad - 1], self.quad_ref
+        return self.quad, self.quad_ref
+
+    def get_normals(self):
+        """Unit outward normals at output boundary vertices [np,3]
+        (PMMG_Get_normalAtVertex source data; zero off-surface)."""
+        if getattr(self, "_out_vn", None) is None:
+            from ..ops.analysis import analyze_mesh
+            res = analyze_mesh(self._out)
+            self._out_vn = np.asarray(res.vnormal)[np.asarray(
+                self._out.vmask)]
+        return self._out_vn
+
+    def get_normal_at_vertex(self, pos: int):
+        n = self.get_normals()[pos - 1]
+        return float(n[0]), float(n[1]), float(n[2])
+
+    def get_scalar_met(self, pos: int) -> float:
+        return float(self.get_metric()[pos - 1])
+
+    def get_scalar_mets(self) -> np.ndarray:
+        return self.get_metric()
+
+    def get_tensor_met(self, pos: int):
+        return tuple(float(x) for x in self.get_metric()[pos - 1])
+
+    def get_tensor_mets(self) -> np.ndarray:
+        return self.get_metric()
+
+    def _out_triangles(self):
+        """(tris 1-based, refs, isRequired, tet_of_tria) of output
+        boundary faces; ``tet_of_tria`` is the 0-based *compacted* id of
+        the tet each boundary face belongs to (used e.g. to assign
+        triangles to the shard that owns the adjacent tet)."""
+        if self._out_tria_cache is not None:
+            return self._out_tria_cache
+        from ..core.mesh import tet_face_vertices
+        m = self._out
+        vm = np.asarray(m.vmask)
+        new_id = np.cumsum(vm) - 1
+        tm = np.asarray(m.tmask)
+        tet_new = np.cumsum(tm) - 1
+        fv = np.asarray(tet_face_vertices(m.tet))
+        ftag = np.asarray(m.ftag)
+        sel = ((ftag & C.MG_BDY) != 0) & tm[:, None]
+        rows = np.nonzero(sel)[0]
+        self._out_tria_cache = (
+            new_id[fv[sel]] + 1, np.asarray(m.fref)[sel],
+            (ftag[sel] & C.MG_REQ) != 0, tet_new[rows])
+        return self._out_tria_cache
 
     def get_vertex_glonum(self, pos: int) -> int:
         if self._glonum is None:
@@ -542,6 +743,37 @@ class ParMesh:
         handled by parallel.comms.global_node_numbering)."""
         vert, _, _, _, _ = self._out_host()
         self._glonum = np.arange(1, len(vert) + 1, dtype=np.int64)
+
+    def get_triangle_glonum(self, pos: int) -> int:
+        """PMMG_Get_triangleGloNum: global id of an output boundary tria
+        (single-process: identity; the two-phase owned/parallel numbering
+        of the reference collapses, libparmmg.c:464)."""
+        return pos
+
+    def get_triangles_glonum(self) -> np.ndarray:
+        return np.arange(1, self._out_ntria() + 1, dtype=np.int64)
+
+    def print_communicator(self, path: str) -> None:
+        """PMMG_printCommunicator (libparmmg.h:2554): dump the staged
+        node/face communicators to a text file for debugging."""
+        with open(path, "w") as f:
+            f.write(f"rank {self.myrank} / {self.nprocs}\n")
+            f.write(f"node communicators: {self.n_node_comm}\n")
+            for i, c in enumerate(self.node_comms):
+                n = 0 if c["local"] is None else len(c["local"])
+                f.write(f"  comm {i}: color_out {c['color_out']} "
+                        f"nitem {n}\n")
+                if n:
+                    for lo, gl in zip(c["local"], c["global_"]):
+                        f.write(f"    {int(lo)} {int(gl)}\n")
+            f.write(f"face communicators: {self.n_face_comm}\n")
+            for i, c in enumerate(self.face_comms):
+                n = 0 if c["local"] is None else len(c["local"])
+                f.write(f"  comm {i}: color_out {c['color_out']} "
+                        f"nitem {n}\n")
+                if n:
+                    for lo, gl in zip(c["local"], c["global_"]):
+                        f.write(f"    {int(lo)} {int(gl)}\n")
 
     @property
     def stats(self):

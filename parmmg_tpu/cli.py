@@ -242,6 +242,94 @@ def _concat_shards(parts):
     return m
 
 
+def _save_distributed_shards(pm, m, out, ndev):
+    """True distributed output: split the adapted mesh into ndev shards
+    and write ``name.<rank>.mesh`` files with ParallelVertex/Triangle
+    communicator sections (inout_pmmg.c:74-486 format) — the
+    checkpoint/resume contract of the reference's -distributed-output."""
+    from .io.medit import MeditMesh
+    from .io.distributed import save_distributed_mesh, ShardComm
+    from .parallel.partition import greedy_partition, fix_contiguity
+    from .parallel.comms import build_interface_comms
+
+    tet0 = np.asarray(m.tetra, np.int64)
+    # reuse the partition the distributed run just produced (it indexes
+    # the compacted output tets, same order as m.tetra); fall back to a
+    # fresh partition for single-device runs or mismatched shapes
+    part = getattr(pm, "_out_part", None)
+    if part is None or len(part) != len(tet0) or part.max() >= ndev:
+        cent = m.vert[tet0].mean(axis=1)
+        part = fix_contiguity(tet0, greedy_partition(tet0, cent, ndev))
+    l2g = [np.unique(tet0[part == s]) for s in range(ndev)]
+    g2l = []
+    for s in range(ndev):
+        mp = np.full(len(m.vert), -1, np.int64)
+        mp[l2g[s]] = np.arange(len(l2g[s]))
+        g2l.append(mp)
+    comms = build_interface_comms(tet0, part, ndev, l2g, g2l)
+
+    # boundary-triangle ownership: a triangle belongs to the shard that
+    # owns its adjacent tetrahedron (vertex membership alone can assign a
+    # fully-on-interface surface triangle to a shard with no matching tet
+    # face)
+    tglob = np.asarray(m.tria, np.int64) if len(m.tria) else \
+        np.zeros((0, 3), np.int64)
+    tri_tet = getattr(m, "tria_tet", None)
+    if tri_tet is not None and len(tri_tet) == len(tglob):
+        tri_owner = part[np.asarray(tri_tet, np.int64)]
+    else:
+        tri_owner = np.full(len(tglob), -1, np.int64)
+        for s in range(ndev):
+            inside = (g2l[s][tglob] >= 0).all(axis=1) if len(tglob) else \
+                np.zeros(0, bool)
+            tri_owner[inside] = s
+
+    for s in range(ndev):
+        sh = MeditMesh()
+        sh.vert = m.vert[l2g[s]]
+        sh.vref = m.vref[l2g[s]]
+        sel = part == s
+        sh.tetra = g2l[s][tet0[sel]].astype(np.int32)
+        sh.tref = m.tref[sel]
+        # shard triangle list: interface faces (from the comm tables, in
+        # table order so comm items can reference them by position),
+        # then the shard's share of the true boundary triangles
+        tris, trefs = [], []
+        face_comms, node_comms = [], []
+        from .core.constants import IDIR
+        for k in range(comms.nbr.shape[1]):
+            b = int(comms.nbr[s, k])
+            if b < 0:
+                continue
+            nf = int(comms.face_cnt[s, k])
+            fidx = comms.face_idx[s, k, :nf]        # 4*local_tet+face
+            lt, lf = fidx // 4, fidx % 4
+            fv = sh.tetra[lt][np.arange(nf)[:, None], np.asarray(IDIR)[lf]]
+            first = sum(len(t) for t in tris)
+            tris.append(fv)
+            trefs.append(np.zeros(nf, np.int32))
+            local_ids = np.arange(first + 1, first + nf + 1)
+            # global face id: stable across both sides = sorted global
+            # vertex triple encoded
+            gfv = np.sort(l2g[s][fv], axis=1)
+            gid = (gfv[:, 0] << 42) | (gfv[:, 1] << 21) | gfv[:, 2]
+            face_comms.append(ShardComm(b, local_ids, gid))
+            nn = int(comms.node_cnt[s, k])
+            nidx = comms.node_idx[s, k, :nn]
+            node_comms.append(ShardComm(
+                b, nidx + 1, l2g[s][nidx] + 1))
+        if len(tglob):
+            # true boundary triangles owned by this shard
+            mine = tri_owner == s
+            tl = g2l[s][tglob[mine]].astype(np.int32)
+            tris.append(tl)
+            trefs.append(m.triaref[mine])
+        if tris:
+            sh.tria = np.concatenate(tris).astype(np.int32)
+            sh.triaref = np.concatenate(trefs)
+        save_distributed_mesh(out, s, sh, face_comms, node_comms)
+
+
 def _report(pm, dt, as_json):
     from .ops.quality import tet_quality
     import jax.numpy as jnp
@@ -287,9 +375,26 @@ def _save_outputs(pm, args):
     m.vert, m.vref = vert, vref
     m.tetra, m.tref = tet - 1, tref
     m.tria, m.triaref = tris - 1, trefs
+    m.tria_tet = pm._out_triangles()[3]     # adjacent-tet provenance
+    # boundary entity sections (Edges/Ridges/Corners/RequiredVertices),
+    # rebuilt from the adapted tags like the reference bdryBuild output
+    edges, erefs, eridge, ereq = pm.get_edges()
+    if len(edges):
+        m.edges, m.edgeref = edges - 1, erefs
+        m.ridges = np.flatnonzero(eridge).astype(np.int32)
+        m.required_edges = np.flatnonzero(ereq).astype(np.int32)
+    _, _, _, _, vtag = pm._out_host()
+    m.corners = np.flatnonzero(vtag & C.MG_CRN).astype(np.int32)
+    m.required_vert = np.flatnonzero(
+        ((vtag & C.MG_REQ) != 0) & ((vtag & C.MG_PARBDY) == 0)
+    ).astype(np.int32)
     if args.dist_out:
         from .io.distributed import save_distributed_mesh
-        save_distributed_mesh(out, 0, m)
+        ndev = pm.info.n_devices
+        if ndev > 1:
+            _save_distributed_shards(pm, m, out, ndev)
+        else:
+            save_distributed_mesh(out, 0, m)
     else:
         write_mesh(out, m)
     met = pm.get_metric()

@@ -107,6 +107,7 @@ def parmmg_run(pm) -> tuple[Mesh, object, AdaptStats]:
                                            nlayers=info.ifc_layers)
             elif it + 1 < niter:
                 part = None          # fresh graph partition next iter
+        pm._out_part = part          # reused by distributed output
 
     # interpolate user fields old mesh -> new mesh
     if bg_fields:

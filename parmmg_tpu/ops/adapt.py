@@ -54,11 +54,18 @@ class AdaptStats:
 
 def adapt_cycle_impl(mesh: Mesh, met: jax.Array, wave: jax.Array,
                      do_swap: bool = True, do_smooth: bool = True,
-                     smooth_waves: int = 2):
-    """One adaptation cycle: split -> collapse -> swap -> smooth.
+                     smooth_waves: int = 1):
+    """One adaptation cycle: split -> collapse -> [swap] -> [smooth].
 
     Pure jittable function (jitted wrapper below) — also the compile-check
     entry point exposed by ``__graft_entry__.entry``.
+
+    Returns (mesh, met, counts) with ``counts`` = int32
+    [nsplit, ncollapse, nswap, nmoved, overflow, live_tets] stacked in
+    ONE device array: the host reads all per-cycle counters with a single
+    transfer (each separate scalar pull costs a full round trip on a
+    remote-device transport, and an *eager* count op on the host would
+    fight the donated input buffers).
     """
     res = split_wave(mesh, met)
     mesh, met = res.mesh, res.met
@@ -92,7 +99,10 @@ def adapt_cycle_impl(mesh: Mesh, met: jax.Array, wave: jax.Array,
             mesh = sm.mesh
             nmoved = nmoved + sm.nmoved
 
-    return mesh, met, nsplit, ncol, nswap, nmoved, overflow
+    counts = jnp.stack([nsplit, ncol, nswap, nmoved,
+                        overflow.astype(jnp.int32),
+                        jnp.sum(mesh.tmask, dtype=jnp.int32)])
+    return mesh, met, counts
 
 
 adapt_cycle = partial(jax.jit, static_argnames=(
@@ -110,8 +120,15 @@ def grow_mesh_met(mesh: Mesh, met, newP: int, newT: int):
 
 
 def adapt_mesh(mesh: Mesh, met: jax.Array, max_cycles: int = 50,
-               verbose: int = 0, headroom: float = 0.85) -> tuple:
+               verbose: int = 0, headroom: float = 0.85,
+               swap_every: int = 3) -> tuple:
     """Host driver: run cycles until no topological change, manage capacity.
+
+    Swap waves cost about as much as split+collapse+smooth combined (they
+    re-derive the edge table and adjacency twice), so they run every
+    ``swap_every``-th cycle — like Mmg, which interleaves swap/move passes
+    between sizing passes rather than swapping continuously — and always
+    once the mesh is near convergence.
 
     Returns (mesh, met, AdaptStats).
     """
@@ -128,9 +145,10 @@ def adapt_mesh(mesh: Mesh, met: jax.Array, max_cycles: int = 50,
                                       max(mesh.capT, int(2 * n_t)))
             stats.regrows += 1
 
-        mesh, met, ns, nc, nw, nm, ovf = adapt_cycle(
-            mesh, met, jnp.asarray(cycle, jnp.int32))
-        ns, nc, nw, nm = int(ns), int(nc), int(nw), int(nm)
+        do_swap = (cycle % swap_every == swap_every - 1) or quiet > 0
+        mesh, met, counts = adapt_cycle(
+            mesh, met, jnp.asarray(cycle, jnp.int32), do_swap=do_swap)
+        ns, nc, nw, nm, ovf, _ = (int(v) for v in np.asarray(counts))
         stats.nsplit += ns
         stats.ncollapse += nc
         stats.nswap += nw
@@ -139,14 +157,16 @@ def adapt_mesh(mesh: Mesh, met: jax.Array, max_cycles: int = 50,
         if verbose >= 3:
             print(f"  cycle {cycle:3d}: split {ns:6d} collapse {nc:6d} "
                   f"swap {nw:6d} move {nm:6d}")
-        if bool(ovf):
+        if ovf:
             mesh, met = grow_mesh_met(mesh, met, 2 * mesh.capP, 2 * mesh.capT)
             stats.regrows += 1
             continue
-        if ns == 0 and nc == 0 and nw == 0:
+        if ns == 0 and nc == 0 and (nw == 0 and do_swap):
             quiet += 1
             if quiet >= 2 or nm == 0:
                 break
+        elif ns == 0 and nc == 0 and not do_swap:
+            quiet = max(quiet, 1)        # trigger a swap-inclusive cycle
         else:
             quiet = 0
     return mesh, met, stats

@@ -68,7 +68,20 @@ def unique_edges(mesh: Mesh) -> EdgeTable:
     nshell = jnp.zeros(capT * 6, jnp.int32).at[eid_sorted].add(ones)
     tags = mesh.etag.reshape(capT * 6)[order]
     tags = jnp.where(valid[order], tags, 0)
-    etag = jnp.zeros(capT * 6, jnp.uint32).at[eid_sorted].max(tags)
+    # true bitwise-OR over each segment (a scatter-max would let a slot
+    # with a numerically larger tag shadow e.g. the MG_REQ bit of another
+    # slot of the same edge): segmented inclusive OR scan, then the last
+    # element of each segment holds the full OR and is scattered to the
+    # segment head (= the unique-edge id)
+    def seg_or(pair_a, pair_b):
+        fa, va = pair_a
+        fb, vb = pair_b
+        return fa | fb, jnp.where(fb, vb, va | vb)
+    _, or_scan = jax.lax.associative_scan(seg_or, (first, tags))
+    n6 = capT * 6
+    is_last = jnp.concatenate([first[1:], jnp.array([True])])
+    etag = jnp.zeros(n6, jnp.uint32).at[
+        jnp.where(is_last, eid_sorted, n6)].set(or_scan, mode="drop")
     # first-3 shell tet ids per edge (for 3-2 swaps): rank within segment
     pos = jnp.arange(capT * 6)
     rank = pos - seg_head
@@ -102,10 +115,13 @@ def edge_lengths(mesh: Mesh, et: EdgeTable, met: jax.Array) -> jax.Array:
 def unique_priority(score: jax.Array, mask: jax.Array) -> jax.Array:
     """Turn a float score into a unique int32 priority (higher = better).
 
-    Ties are broken by slot index via argsort rank; masked slots get
-    priority 0.  Used by the independent-set claim resolution in the remesh
-    kernels (the parallel analogue of Mmg's sequential everything-in-order
-    application).
+    Ties are broken by argsort rank; masked slots get priority 0.  Used by
+    the independent-set claim resolution in the remesh kernels (the
+    parallel analogue of Mmg's sequential everything-in-order
+    application).  NOTE a sortless quantized variant (score top-bits +
+    slot-index tie-break) was tried and reverted: index-ordered tie-breaks
+    spatially bias the winner sets and measurably degrade final min
+    quality.
     """
     n = score.shape[0]
     neg = jnp.where(mask, -score, jnp.inf)

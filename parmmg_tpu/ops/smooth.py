@@ -54,12 +54,11 @@ def smooth_wave(mesh: Mesh, met: jax.Array, wave: int = 0,
     # Try a cascade of relaxation factors (Mmg's movtet retries with damped
     # steps); each vertex takes the largest step whose ball min-quality
     # strictly improves.
-    if met.ndim == 1:
-        from .quality import iso_to_tensor
-        m6 = iso_to_tensor(met)
-    else:
-        m6 = met
-    mq = m6[tv]                                            # [T,4,6]
+    # iso: Euclidean quality (MMG5_caltet_iso — local scaling cancels);
+    # aniso: per-corner packed tensors.  Skipping the [T,4,6] gather and
+    # the tensor math in the 12 quality evaluations below is a large TPU
+    # win per wave.
+    mq = None if met.ndim == 1 else met[tv]                # [T,4,6] | None
     q_old = quality_from_points(vpos, mq)                  # [T]
     minq_old = jnp.full(capP + 1, jnp.inf, mesh.vert.dtype)
     for k in range(4):

@@ -28,11 +28,6 @@ from jax.sharding import Mesh as DeviceMesh, PartitionSpec as P, NamedSharding
 from jax import shard_map
 
 from ..core.mesh import Mesh
-from ..ops.adjacency import build_adjacency
-from ..ops.split import split_wave
-from ..ops.collapse import collapse_wave
-from ..ops.swap import swap32_wave, swap23_wave
-from ..ops.smooth import smooth_wave
 from ..ops.quality import tet_quality, quality_histogram
 
 
@@ -57,36 +52,28 @@ def shard_stacked(stacked, dmesh: DeviceMesh):
     return jax.tree.map(lambda x: jax.device_put(x, sh), stacked)
 
 
-def dist_adapt_cycle(dmesh: DeviceMesh):
+def dist_adapt_cycle(dmesh: DeviceMesh, do_swap: bool = True):
     """Build the jitted SPMD adapt step for a given device mesh.
+
+    The per-shard body is the same ``adapt_cycle_impl`` as the single-chip
+    path (frozen MG_PARBDY interfaces make it correct under SPMD); the
+    counters are globally ``psum``-reduced — the analogue of the
+    reference's Allreduce(ier/counters) phase-agreement idiom
+    (libparmmg1.c:812).
 
     Returns fn(stacked_mesh, stacked_met, wave) ->
       (stacked_mesh, stacked_met, global_counts[4], any_overflow).
     """
+    from ..ops.adapt import adapt_cycle_impl
     spec = P("shard")
 
     def local_cycle(mesh_s: Mesh, met_s, wave):
         mesh = _unstack(mesh_s)
         met = met_s[0]
-        res = split_wave(mesh, met)
-        mesh, met = res.mesh, res.met
-        mesh = build_adjacency(mesh)
-        col = collapse_wave(mesh, met)
-        mesh = build_adjacency(col.mesh)
-        from ..ops.adjacency import boundary_edge_tags
-        mesh = boundary_edge_tags(mesh)      # re-tag rewired surface
-        s32 = swap32_wave(mesh, met)
-        mesh = build_adjacency(s32.mesh)
-        s23 = swap23_wave(mesh, met)
-        mesh = build_adjacency(s23.mesh)
-        for w in range(2):
-            sm = smooth_wave(mesh, met, wave=wave * 2 + w)
-            mesh = sm.mesh
-        # global agreement — the psum analogue of Allreduce(ier/counters)
-        counts = jnp.stack([res.nsplit, col.ncollapse,
-                            s32.nswap + s23.nswap, sm.nmoved])
-        counts = jax.lax.psum(counts, "shard")
-        ovf = jax.lax.pmax(res.overflow.astype(jnp.int32), "shard")
+        mesh, met, counts = adapt_cycle_impl(
+            mesh, met, wave, do_swap=do_swap, smooth_waves=2)
+        ovf = jax.lax.pmax(counts[4], "shard")
+        counts = jax.lax.psum(counts[:4], "shard")
         return _restack(mesh), met[None], counts, ovf
 
     fn = shard_map(local_cycle, mesh=dmesh,
@@ -149,7 +136,8 @@ def distributed_adapt(mesh: Mesh, met, n_shards: int,
         part = fix_contiguity(tet, part)
 
     cap_mult = 3.0
-    step = dist_adapt_cycle(dmesh)
+    step_full = dist_adapt_cycle(dmesh, do_swap=True)
+    step_light = dist_adapt_cycle(dmesh, do_swap=False)
     stacked = met_s = None
     c = 0
     regrows = 0
@@ -159,6 +147,9 @@ def distributed_adapt(mesh: Mesh, met, n_shards: int,
                                     cap_mult=cap_mult)
             stacked = shard_stacked(s, dmesh)
             met_s = shard_stacked(ms, dmesh)
+        # swaps every 3rd cycle (see ops.adapt.adapt_mesh) and on the
+        # final two (quality polish before the merge)
+        step = step_full if (c % 3 == 2 or c >= cycles - 2) else step_light
         stacked, met_s, counts, ovf = step(stacked, met_s,
                                            jnp.asarray(c, jnp.int32))
         cs = np.asarray(counts)
@@ -185,7 +176,7 @@ def distributed_adapt(mesh: Mesh, met, n_shards: int,
             stacked = None
             continue
         c += 1
-        if cs[0] == 0 and cs[1] == 0 and cs[2] == 0:
+        if step is step_full and cs[0] == 0 and cs[1] == 0 and cs[2] == 0:
             break
     merged, met_m, part_new = merge_shards(stacked, met_s,
                                            return_part=True)
