@@ -90,6 +90,12 @@ class Info:
     anisosize: bool = False
     opnbdy: bool = False
     fem: bool = False
+    # unsupported-feature knobs, accepted then rejected at run() like the
+    # reference's PMMG_check_inputData (libparmmg.c:69-81): level-set
+    # discretization and lagrangian motion are settable but refused
+    iso: bool = False
+    lag: int = -1
+    ls_value: float = 0.0
     mem_budget_mb: int = -1
     # geometry thresholds
     angle_deg: float = C.ANGEDG_DEG
@@ -113,6 +119,9 @@ class Info:
             IParam.mmgVerbose: ("mmg_imprim", int),
             IParam.mem: ("mem_budget_mb", int),
             IParam.debug: ("debug", bool),
+            IParam.mmgDebug: ("mmg_debug", bool),
+            IParam.iso: ("iso", bool),
+            IParam.lag: ("lag", int),
             IParam.angle: ("angle_detection", bool),
             IParam.optim: ("optim", bool),
             IParam.optimLES: ("optimLES", bool),
@@ -147,11 +156,30 @@ class Info:
             DParam.hausd: "hausd",
             DParam.hgrad: "hgrad",
             DParam.hgradreq: "hgradreq",
+            DParam.ls: "ls_value",
             DParam.groupsRatio: "grps_ratio",
         }
         if key not in m:
             raise KeyError(f"unsupported dparam {key}")
         setattr(self, m[key], float(val))
+
+
+class InputError(ValueError):
+    """Unsupported input combination, refused like the reference's
+    PMMG_check_inputData (libparmmg.c:55-101)."""
+
+
+def check_input_data(info: Info, met_is_aniso: bool = False) -> None:
+    """Graded input rejection (PMMG_check_inputData, libparmmg.c:69-101):
+    lagrangian motion and level-set discretization are unavailable; an
+    anisotropic metric is incompatible with -optimLES."""
+    if info.lag > -1:
+        raise InputError("lagrangian motion option unavailable")
+    if info.iso:
+        raise InputError("level-set discretization option unavailable")
+    if info.optimLES and met_is_aniso:
+        raise InputError("-optimLES is not compatible with an anisotropic "
+                         "metric")
 
 
 def resolve_target_mesh_size(info: Info, ne_global: int, n_devices: int)\

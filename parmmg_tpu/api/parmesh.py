@@ -117,11 +117,13 @@ class ParMesh:
                               None, np.int32)
 
     def get_mesh_size(self):
-        """PMMG_Get_meshSize."""
+        """PMMG_Get_meshSize: sizes of the CURRENT mesh — after run() the
+        adapted output (incl. the rebuilt feature-edge count, so
+        ``for i in 1..na: get_edge(i)`` walks the output edges)."""
         if self._out is not None:
             vert, tet, _, _, _ = self._out_host()
             return len(vert), len(tet), self.nprism_, self._out_ntria(), \
-                self.nquad_, self.na_
+                self.nquad_, len(self.get_edges()[0])
         return self.np_, self.ne_, self.nprism_, self.nt_, self.nquad_, \
             self.na_
 
@@ -415,9 +417,28 @@ class ParMesh:
             (self.quad.reshape(-1) if self.nquad_ else
              np.zeros(0, np.int64))])
         if len(hybrid):
+            hyb = np.zeros(mesh.capP, bool)
+            hyb[(hybrid - 1).astype(np.int64)] = True
             vtag = np.array(np.asarray(mesh.vtag), copy=True)
-            vtag[(hybrid - 1).astype(np.int64)] |= C.MG_REQ
-            mesh = dataclasses.replace(mesh, vtag=jnp.asarray(vtag))
+            vtag[hyb] |= C.MG_REQ
+            # freeze the tet<->hybrid interface at full depth: any tet
+            # face/edge whose vertices are all hybrid vertices lies on a
+            # pass-through element; splitting such an edge would hang a
+            # midpoint on the prism/quad face (non-conforming result).
+            # Same mechanism as the required-tetra freeze below.
+            from ..core.constants import IDIR, IARE
+            tv = np.asarray(mesh.tet)
+            hv = hyb[np.clip(tv, 0, mesh.capP - 1)] \
+                & np.asarray(mesh.tmask)[:, None]
+            ftag = np.array(np.asarray(mesh.ftag), copy=True)
+            etag = np.array(np.asarray(mesh.etag), copy=True)
+            for f in range(4):
+                ftag[hv[:, IDIR[f]].all(axis=1), f] |= C.MG_REQ
+            for e in range(6):
+                etag[hv[:, IARE[e]].all(axis=1), e] |= C.MG_REQ
+            mesh = dataclasses.replace(
+                mesh, vtag=jnp.asarray(vtag), ftag=jnp.asarray(ftag),
+                etag=jnp.asarray(etag))
 
         # required tetrahedra: freeze all their entities (faces, edges,
         # vertices get MG_REQ) so no wave touches them — the contract the
@@ -515,8 +536,14 @@ class ParMesh:
         """The adaptation entry (PMMG_parmmglib_centralized /_distributed
         depending on staged comms).  Returns PMMG_SUCCESS/…"""
         from ..driver import parmmg_run
+        from .params import InputError
         try:
             out, met, stats = parmmg_run(self)
+        except InputError as e:
+            if self.info.imprim >= 0:
+                import sys
+                print(f"  ## Error: {e}.", file=sys.stderr)
+            return C.PMMG_STRONGFAILURE
         except MemoryError:
             return C.PMMG_STRONGFAILURE
         self._out, self._out_met, self._out_stats = out, met, stats
@@ -526,6 +553,7 @@ class ParMesh:
         self._out_host_cache = None
         self._out_edges_cache = None
         self._out_tria_cache = None
+        self._out_ftag_cache = None
         return C.PMMG_SUCCESS
 
     # ------------------------------------------------------------------
@@ -587,9 +615,13 @@ class ParMesh:
         triangles reads back as required too (the flat mesh carries no
         separate per-tet flag)."""
         _, tet, _, tref, _ = self._out_host()
-        m = self._out
-        ftag = np.asarray(m.ftag)[np.asarray(m.tmask)]
-        req = bool((ftag[pos - 1] & C.MG_REQ).all())
+        # cache the compacted ftag: the natural usage loops over all tets
+        # and a fresh device pull per call would be O(N^2)
+        if getattr(self, "_out_ftag_cache", None) is None:
+            m = self._out
+            self._out_ftag_cache = \
+                np.asarray(m.ftag)[np.asarray(m.tmask)]
+        req = bool((self._out_ftag_cache[pos - 1] & C.MG_REQ).all())
         return tuple(int(v) + 1 for v in tet[pos - 1]) + \
             (int(tref[pos - 1]), req)
 
@@ -638,8 +670,8 @@ class ParMesh:
             orig = (e < self.np_).all(axis=1)       # original-vertex rows
             ue = np.sort(self.edge - 1, axis=1)
             ukey = ue[:, 0].astype(np.int64) << 32 | ue[:, 1]
-            ekey = np.sort(e, axis=1)
-            ekey = ekey[:, 0].astype(np.int64) << 32 | ekey[:, 1]
+            # e rows are already (min,max)-sorted from construction
+            ekey = e[:, 0].astype(np.int64) << 32 | e[:, 1]
             o = np.argsort(ukey)
             pos = np.clip(np.searchsorted(ukey[o], ekey), 0, len(ukey) - 1)
             hit = orig & (ukey[o][pos] == ekey)

@@ -57,7 +57,10 @@ def parmmg_run(pm) -> tuple[Mesh, object, AdaptStats]:
     """Run the full adaptation per the staged ParMesh. Returns
     (adapted core Mesh, metric, stats)."""
     from .utils.timers import Timers
+    from .api.params import check_input_data
     info = pm.info
+    check_input_data(info, met_is_aniso=(
+        pm.met is not None and getattr(pm.met, "ndim", 1) == 2))
     tim = Timers()
     with tim("analysis"):
         mesh, met = pm._build_core_mesh()

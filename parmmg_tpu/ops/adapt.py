@@ -110,6 +110,36 @@ adapt_cycle = partial(jax.jit, static_argnames=(
     donate_argnums=(0, 1))(adapt_cycle_impl)
 
 
+def adapt_cycles_fused_impl(mesh: Mesh, met: jax.Array, wave0: jax.Array,
+                            n_cycles: int = 3, swap_every: int = 3):
+    """``n_cycles`` adaptation cycles in ONE jitted program.
+
+    On a remote-attached TPU every dispatch pays a transport round trip
+    (and the per-cycle counter pull is a host sync); fusing a block of
+    cycles amortizes both and gives XLA one big program to schedule.  The
+    swap cadence is compiled in (cycle c swaps iff c % swap_every ==
+    swap_every-1, matching the host driver); counters come back stacked
+    [n_cycles, 6] and are read with a single transfer.
+
+    Overflow safety: a capacity overflow inside the block only truncates
+    that cycle's winner set (split_wave drops the lowest-priority winners
+    that don't fit); the flag is reported per cycle so the host can regrow
+    and rerun as usual.
+    """
+    counts_all = []
+    for c in range(n_cycles):
+        do_swap = (c % swap_every == swap_every - 1)
+        mesh, met, counts = adapt_cycle_impl(
+            mesh, met, wave0 + c, do_swap=do_swap)
+        counts_all.append(counts)
+    return mesh, met, jnp.stack(counts_all)
+
+
+adapt_cycles_fused = partial(jax.jit, static_argnames=(
+    "n_cycles", "swap_every"),
+    donate_argnums=(0, 1))(adapt_cycles_fused_impl)
+
+
 def grow_mesh_met(mesh: Mesh, met, newP: int, newT: int):
     """Grow capacities, carrying the metric through compact()'s permutation."""
     vperm = np.argsort(~np.asarray(mesh.vmask), kind="stable")

@@ -32,7 +32,8 @@ from ..core.mesh import Mesh
 from ..core.constants import (
     IDIR, LSHRT, LLONG, EPSD, MG_BDY, MG_CRN, MG_GEO, MG_NOM, MG_REF,
     MG_REQ, MG_PARBDY, QUAL_FLOOR)
-from .edges import unique_edges, edge_lengths, unique_priority
+from .edges import (unique_edges, edge_lengths, claim_channels,
+                    scatter_argmax2, NEG_INF, PRI_MIN)
 
 _IDIR_J = jnp.asarray(IDIR)
 
@@ -80,19 +81,24 @@ def collapse_wave(mesh: Mesh, met: jax.Array, lmin: float = LSHRT,
     kp = jnp.where(del_b, va, vb)
     cand = short & (rem_a | rem_b)
 
-    pri = unique_priority(-lens, cand)                     # short = high
-    # per-vertex top remover and its kept endpoint
-    rmpri = jnp.zeros(capP, jnp.int32).at[rm].max(jnp.where(cand, pri, 0))
-    is_top = cand & (pri == rmpri[rm]) & (pri > 0)
+    # sort-free claim priority: (s, t) = (-length, unique hash); shorter
+    # edge = higher score, ties broken without spatial bias
+    s, t = claim_channels(-lens, cand)
+    # per-vertex top remover and its kept endpoint; v_s/v_t are the
+    # per-vertex channel maxima (the sortless 'rmpri')
+    is_top, v_s, v_t = scatter_argmax2(rm, s, t, cand, capP)
     kept_of = jnp.zeros(capP, jnp.int32).at[
-        jnp.where(is_top, rm, capP)].set(kp, mode="drop")
+        jnp.where(is_top, rm, capP)].set(kp, mode="drop",
+                                         unique_indices=True)
 
     # --- geometric validity of top removers, tet-centric -----------------
     # for each (tet, corner k): v = tet[k]; if v is a top-removal target,
     # simulate v -> kept_of[v] and test volumes / fold-over / new lengths.
     tv = mesh.tet                                          # [T,4]
     vpos = mesh.vert[tv]                                   # [T,4,3]
-    vt = rmpri[tv]                                         # [T,4] pri or 0
+    vs_c = v_s[tv]                                         # [T,4] score max
+    vt_c = v_t[tv]                                         # [T,4] tie max
+    has_c = jnp.isfinite(vs_c)        # corner is a top-removal target
     kept = kept_of[tv]                                     # [T,4]
     kept_pos = mesh.vert[kept]                             # [T,4,3]
     # does this tet also contain the kept vertex? then it dies, skip checks
@@ -106,7 +112,7 @@ def collapse_wave(mesh: Mesh, met: jax.Array, lmin: float = LSHRT,
     geombad = jnp.zeros(capP + 1, bool)
     newlong = jnp.zeros(capP + 1, bool)
     for k in range(4):
-        active = (vt[:, k] > 0) & mesh.tmask & ~contains_kept[:, k]
+        active = has_c[:, k] & mesh.tmask & ~contains_kept[:, k]
         p = vpos.at[:, k].set(kept_pos[:, k])              # moved corner
         d1 = p[:, 1] - p[:, 0]
         d2 = p[:, 2] - p[:, 0]
@@ -141,26 +147,39 @@ def collapse_wave(mesh: Mesh, met: jax.Array, lmin: float = LSHRT,
             bad, mode="drop")
     geombad = geombad[:capP] | newlong[:capP]
 
-    # --- claims ----------------------------------------------------------
-    vclaim = jnp.zeros(capP, jnp.int32)
-    vclaim = vclaim.at[rm].max(jnp.where(cand, pri, 0))
-    vclaim = vclaim.at[kp].max(jnp.where(cand, pri, 0))
-    # tet claim = max removal-pri over its 4 corners
-    tclaim = jnp.max(vt, axis=1)
-    # bad claim: some tet of rm's ball is contested by a higher claim
+    # --- claims (two-channel, sort-free) ---------------------------------
+    # tet claim = (s,t)-max removal target over the 4 corners; a corner
+    # with a target loses its tets if it is not the tet's max holder
+    tmax_s = jnp.max(jnp.where(mesh.tmask[:, None], vs_c, NEG_INF), axis=1)
+    sel = (vs_c == tmax_s[:, None]) & jnp.isfinite(tmax_s)[:, None]
+    tsel = jnp.where(sel, vt_c, PRI_MIN)
+    tmax_t = jnp.max(tsel, axis=1)
+    corner_max = sel & (tsel == tmax_t[:, None])
     contested = jnp.zeros(capP + 1, bool)
     for k in range(4):
-        mism = (vt[:, k] > 0) & (tclaim != vt[:, k]) & mesh.tmask
+        mism = has_c[:, k] & ~corner_max[:, k] & mesh.tmask
         contested = contested.at[
             jnp.where(mesh.tmask, tv[:, k], capP)].max(mism, mode="drop")
     contested = contested[:capP]
 
-    win = (cand & (pri == rmpri[rm]) & ~geombad[rm] & ~contested[rm]
-           & (pri == vclaim[rm]) & (pri == vclaim[kp]))
+    # vertex claims: a winner must be the (s,t)-max among all candidate
+    # edges touching either of its endpoints (both roles)
+    cl_s = jnp.full(capP + 1, NEG_INF)
+    cl_s = cl_s.at[jnp.where(cand, rm, capP)].max(s, mode="drop")
+    cl_s = cl_s.at[jnp.where(cand, kp, capP)].max(s, mode="drop")
+    eq_rm = cand & (s == cl_s[rm])
+    eq_kp = cand & (s == cl_s[kp])
+    cl_t = jnp.full(capP + 1, PRI_MIN)
+    cl_t = cl_t.at[jnp.where(eq_rm, rm, capP)].max(t, mode="drop")
+    cl_t = cl_t.at[jnp.where(eq_kp, kp, capP)].max(t, mode="drop")
+    claim_ok = eq_rm & (t == cl_t[rm]) & eq_kp & (t == cl_t[kp])
+
+    win = cand & is_top & ~geombad[rm] & ~contested[rm] & claim_ok
 
     # --- apply: vertex remap + dead shell tets ---------------------------
     remap = jnp.arange(capP, dtype=jnp.int32)
-    remap = remap.at[jnp.where(win, rm, capP)].set(kp, mode="drop")
+    remap = remap.at[jnp.where(win, rm, capP)].set(
+        kp, mode="drop", unique_indices=True)   # winners exclusive at rm
     new_tet = remap[mesh.tet]
     # dead = any duplicated vertex pair (tet contained rm and kp)
     dup = jnp.zeros(capT, bool)
@@ -185,7 +204,48 @@ def collapse_wave(mesh: Mesh, met: jax.Array, lmin: float = LSHRT,
     ftag = jnp.where(recv, mesh.ftag | nbr_ftag, mesh.ftag)
     fref = jnp.where(recv & (nbr_fref != 0), nbr_fref, mesh.fref)
 
+    # --- transfer edge tags from dying tets to surviving slots -----------
+    # The collapse merges edge (u,rm) into (u,kp).  Mmg's colver unites
+    # the tags of the merged edges; without this, a ridge edge loses its
+    # MG_GEO when every tet carrying the tagged slot dies (all its shell
+    # tets contain rm AND kp) — the untagged ridge then erodes (volume
+    # loss).  Batched equivalent: a keyed OR-join — sort ALL remapped
+    # slot keys (surviving slots as receivers, dying tets' slots as
+    # donors of their OLD tag) and OR each key group's donor tags into
+    # its receivers.
+    from ..core.mesh import tet_edge_vertices
+    _I32MAX = 2147483647
+    ev_new = tet_edge_vertices(new_tet).reshape(capT * 6, 2)
+    ka = jnp.minimum(ev_new[:, 0], ev_new[:, 1])
+    kb = jnp.maximum(ev_new[:, 0], ev_new[:, 1])
+    alive_s = jnp.repeat(tmask, 6)
+    donor_s = jnp.repeat(dead, 6)
+    rel = alive_s | donor_s
+    ka = jnp.where(rel, ka, _I32MAX)
+    kb = jnp.where(rel, kb, _I32MAX)
+    order = jnp.lexsort((kb, ka))
+    ska, skb = ka[order], kb[order]
+    first = jnp.concatenate([jnp.array([True]),
+                             (ska[1:] != ska[:-1]) | (skb[1:] != skb[:-1])])
+    seg = jax.lax.associative_scan(
+        jnp.maximum, jnp.where(first, jnp.arange(capT * 6), 0))
+    dtag = jnp.where(donor_s[order], mesh.etag.reshape(capT * 6)[order], 0)
+    # segment OR of donor tags, then broadcast the segment total back to
+    # every member (the OR-scan total sits at the LAST member)
+    from .edges import segmented_or
+    or_fwd = segmented_or(first, dtag)
+    is_last = jnp.concatenate([first[1:], jnp.array([True])])
+    # per-segment total, scattered to the head slot then gathered by seg id
+    total_at_head = jnp.zeros(capT * 6 + 1, jnp.uint32).at[
+        jnp.where(is_last, seg, capT * 6)].set(
+        or_fwd, mode="drop", unique_indices=True)
+    add_sorted = total_at_head[seg]                       # [capE] per slot
+    add = jnp.zeros(capT * 6, jnp.uint32).at[order].set(
+        add_sorted, unique_indices=True).reshape(capT, 6)
+    etag = jnp.where(tmask[:, None], mesh.etag | add, mesh.etag)
+
     ncol = jnp.sum(win.astype(jnp.int32))
     out = dataclasses.replace(
-        mesh, tet=new_tet, tmask=tmask, vmask=vmask, ftag=ftag, fref=fref)
+        mesh, tet=new_tet, tmask=tmask, vmask=vmask, ftag=ftag, fref=fref,
+        etag=etag)
     return CollapseResult(out, ncol)

@@ -20,6 +20,21 @@ from ..core.constants import IARE
 _INT32_MAX = 2147483647
 
 
+def segmented_or(first: jax.Array, values: jax.Array) -> jax.Array:
+    """Inclusive segmented bitwise-OR scan over sorted segments.
+
+    ``first`` marks segment heads; returns the running OR within each
+    segment (the LAST element of a segment holds the full segment OR).
+    Shared by unique_edges and the collapse edge-tag transfer join.
+    """
+    def seg_or(pair_a, pair_b):
+        fa, va = pair_a
+        fb, vb = pair_b
+        return fa | fb, jnp.where(fb, vb, va | vb)
+    _, out = jax.lax.associative_scan(seg_or, (first, values))
+    return out
+
+
 class EdgeTable(NamedTuple):
     """Unique edges of the mesh.  capE = 6*capT slots, masked.
 
@@ -36,6 +51,9 @@ class EdgeTable(NamedTuple):
     nshell: jax.Array   # [capE] int32
     edge_id: jax.Array  # [capT, 6] int32
     shell3: jax.Array   # [capE, 3] int32 first 3 shell tet ids (-1 unused)
+    shell_rank: jax.Array  # [capT, 6] int32 rank of this tet in the edge's
+    #                     shell (ascending tet id) — free by-product of the
+    #                     sort; lets split_wave skip its own ranking sort
 
 
 def unique_edges(mesh: Mesh) -> EdgeTable:
@@ -57,15 +75,19 @@ def unique_edges(mesh: Mesh) -> EdgeTable:
     # use the sorted position itself as the unique edge id (stable, dense
     # enough). Scatter back to (tet, local edge) slots.
     eid_sorted = seg_head
+    # `order` is a permutation: unique_indices lets XLA apply the scatter
+    # fully in parallel (TPU scatter is serialized when it must assume
+    # duplicate targets)
     eid = jnp.zeros(capT * 6, jnp.int32).at[order].set(
-        eid_sorted.astype(jnp.int32))
+        eid_sorted.astype(jnp.int32), unique_indices=True)
     edge_id = eid.reshape(capT, 6)
 
     emask = first & (ka != _INT32_MAX)
     ev_u = jnp.stack([ka, kb], axis=1)
     # shell size + tag OR per unique edge (segment sums via scatter-add)
     ones = (valid[order]).astype(jnp.int32)
-    nshell = jnp.zeros(capT * 6, jnp.int32).at[eid_sorted].add(ones)
+    nshell = jnp.zeros(capT * 6, jnp.int32).at[eid_sorted].add(
+        ones, indices_are_sorted=True)
     tags = mesh.etag.reshape(capT * 6)[order]
     tags = jnp.where(valid[order], tags, 0)
     # true bitwise-OR over each segment (a scatter-max would let a slot
@@ -73,15 +95,12 @@ def unique_edges(mesh: Mesh) -> EdgeTable:
     # slot of the same edge): segmented inclusive OR scan, then the last
     # element of each segment holds the full OR and is scattered to the
     # segment head (= the unique-edge id)
-    def seg_or(pair_a, pair_b):
-        fa, va = pair_a
-        fb, vb = pair_b
-        return fa | fb, jnp.where(fb, vb, va | vb)
-    _, or_scan = jax.lax.associative_scan(seg_or, (first, tags))
+    or_scan = segmented_or(first, tags)
     n6 = capT * 6
     is_last = jnp.concatenate([first[1:], jnp.array([True])])
     etag = jnp.zeros(n6, jnp.uint32).at[
-        jnp.where(is_last, eid_sorted, n6)].set(or_scan, mode="drop")
+        jnp.where(is_last, eid_sorted, n6)].set(
+        or_scan, mode="drop", unique_indices=True)
     # first-3 shell tet ids per edge (for 3-2 swaps): rank within segment
     pos = jnp.arange(capT * 6)
     rank = pos - seg_head
@@ -89,9 +108,15 @@ def unique_edges(mesh: Mesh) -> EdgeTable:
     shell3 = jnp.full((capT * 6, 3), -1, jnp.int32)
     tgt_e = jnp.where(valid[order] & (rank < 3), eid_sorted, capT * 6)
     shell3 = shell3.at[tgt_e, jnp.clip(rank, 0, 2)].set(
-        tet_of_slot, mode="drop")
+        tet_of_slot, mode="drop", unique_indices=True)
+    # per (tet, local edge) slot: rank of the tet within its edge's shell.
+    # The stable lexsort keeps equal keys in slot order (= ascending tet
+    # id), so this equals a rank-among-shell-tets-by-tet-id — computed here
+    # for free and reused by split_wave's slot assignment.
+    shell_rank = jnp.zeros(capT * 6, jnp.int32).at[order].set(
+        rank.astype(jnp.int32), unique_indices=True).reshape(capT, 6)
     return EdgeTable(ev=ev_u, emask=emask, etag=etag, nshell=nshell,
-                     edge_id=edge_id, shell3=shell3)
+                     edge_id=edge_id, shell3=shell3, shell_rank=shell_rank)
 
 
 def edge_lengths(mesh: Mesh, et: EdgeTable, met: jax.Array) -> jax.Array:
@@ -122,6 +147,11 @@ def unique_priority(score: jax.Array, mask: jax.Array) -> jax.Array:
     slot-index tie-break) was tried and reverted: index-ordered tie-breaks
     spatially bias the winner sets and measurably degrade final min
     quality.
+
+    Retained for reference/tests; the production waves use the sort-free
+    two-channel scheme below (full-precision f32 score + bijective-hash
+    tie-break), which has the same total order without the O(n log^2 n)
+    TPU sort and without the spatial bias of index tie-breaks.
     """
     n = score.shape[0]
     neg = jnp.where(mask, -score, jnp.inf)
@@ -130,3 +160,66 @@ def unique_priority(score: jax.Array, mask: jax.Array) -> jax.Array:
         jnp.arange(n, dtype=jnp.int32))
     pri = n - rank                    # in [1, n], unique
     return jnp.where(mask, pri, 0).astype(jnp.int32)
+
+
+# ---------------------------------------------------------------------------
+# Sort-free claim priorities.
+#
+# The waves need a deterministic TOTAL order over candidate entities to
+# resolve claim conflicts.  A rank (sort) gives one, but TPU sorts are
+# O(n log^2 n) bitonic passes.  Instead compare candidates by the pair
+#   (score: float32, tie: int32)
+# lexicographically: the score keeps its FULL f32 precision (no
+# quantization), and the tie channel is a *bijective* integer mix of the
+# slot index — unique by construction, pseudo-random in order, so equal
+# scores (ubiquitous in structured meshes) break without spatial bias.
+# Claim resolution then needs only elementwise max / scatter-max passes:
+# first on the score channel, then on the tie channel restricted to
+# score-maximal slots.
+# ---------------------------------------------------------------------------
+PRI_MIN = jnp.int32(-2147483648)     # tie-channel sentinel (< every hash)
+NEG_INF = jnp.float32(-jnp.inf)      # score-channel sentinel
+
+
+def tie_hash(n: int, salt: int = 0) -> jax.Array:
+    """Unique pseudo-random int32 per slot: a bijective avalanche mix of
+    the index (odd multiplications and xor-shifts are invertible mod
+    2^32), so distinct slots NEVER collide — the total order is exact."""
+    x = jnp.arange(n, dtype=jnp.uint32) + jnp.uint32(salt) * jnp.uint32(
+        2246822519)
+    x = x * jnp.uint32(2654435761)
+    x = x ^ (x >> 16)
+    x = x * jnp.uint32(2246822519)
+    x = x ^ (x >> 13)
+    x = x * jnp.uint32(3266489917)
+    x = x ^ (x >> 16)
+    return x.astype(jnp.int32)
+
+
+def claim_channels(score: jax.Array, mask: jax.Array, salt: int = 0):
+    """(s, t) channels for the two-channel claim scheme: masked slots get
+    (-inf, PRI_MIN) and lose every comparison."""
+    s = jnp.where(mask, score.astype(jnp.float32), NEG_INF)
+    t = jnp.where(mask, tie_hash(score.shape[0], salt), PRI_MIN)
+    return s, t
+
+
+def scatter_argmax2(site: jax.Array, s: jax.Array, t: jax.Array,
+                    mask: jax.Array, nsites: int):
+    """Is each slot the unique (s,t)-max among slots scattered to its site?
+
+    Returns (is_max [slots] bool, c_s [nsites+1], c_t [nsites+1]):
+    ``is_max`` is True iff ``mask`` and no other slot with the same
+    ``site`` has a lexicographically larger (s, t); c_s/c_t are the
+    per-site channel maxima (sentinels where no slot landed).  Two
+    scatter-max passes; exact because t is unique.
+    """
+    sited = jnp.clip(site, 0, nsites - 1)
+    safe = jnp.where(mask, site, nsites)
+    c_s = jnp.full(nsites + 1, NEG_INF).at[safe].max(
+        jnp.where(mask, s, NEG_INF), mode="drop")
+    at_max = mask & (s == c_s[sited])
+    safe2 = jnp.where(at_max, site, nsites)
+    c_t = jnp.full(nsites + 1, PRI_MIN).at[safe2].max(
+        jnp.where(at_max, t, PRI_MIN), mode="drop")
+    return at_max & (t == c_t[sited]), c_s, c_t

@@ -23,7 +23,7 @@ from ..core.mesh import Mesh
 from ..core.constants import (
     MG_BDY, MG_CRN, MG_GEO, MG_REQ, MG_PARBDY, QUAL_FLOOR)
 from .quality import quality_from_points
-from .edges import unique_priority
+from .edges import PRI_MIN
 
 
 class SmoothResult(NamedTuple):
@@ -87,23 +87,22 @@ def smooth_wave(mesh: Mesh, met: jax.Array, wave: int = 0,
     improves = best_gain > 0
 
     # --- independent set: vertex claims its ball tets --------------------
-    # wave-rotated hash: full avalanche mix so the *ordering* changes with
-    # the wave even after the float32 cast inside unique_priority (a plain
-    # additive offset is lost to rounding and repeats the same winner set)
+    # wave-rotated hash: a full-avalanche BIJECTIVE mix (odd multiplies +
+    # xor-shifts, invertible mod 2^32), so per-wave priorities are unique
+    # by construction and usable directly as the claim order — no sort
     wv = jnp.asarray(wave, jnp.uint32)
     h = jnp.arange(capP, dtype=jnp.uint32) * jnp.uint32(2654435761)
     h = h + wv * jnp.uint32(2246822519)
     h = h ^ (h >> 15)
     h = h * jnp.uint32(2654435761)
     h = h ^ (h >> 13)
-    h = h & jnp.uint32(0x7FFFFFFF)
-    pri = unique_priority(h.astype(jnp.float32), improves)
-    vpri = jnp.where(improves, pri, 0)
-    tclaim = jnp.max(jnp.where(mesh.tmask[:, None], vpri[tv], 0), axis=1)
+    vpri = jnp.where(improves, h.astype(jnp.int32), PRI_MIN)
+    tclaim = jnp.max(jnp.where(mesh.tmask[:, None], vpri[tv], PRI_MIN),
+                     axis=1)
     lost = jnp.zeros(capP + 1, bool)
     for k in range(4):
         idx = jnp.where(mesh.tmask, tv[:, k], capP)
-        mism = (vpri[tv[:, k]] > 0) & (tclaim != vpri[tv[:, k]])
+        mism = improves[tv[:, k]] & (tclaim != vpri[tv[:, k]])
         lost = lost.at[idx].max(mism, mode="drop")
     win = improves & ~lost[:capP]
 

@@ -31,7 +31,8 @@ from ..core.mesh import Mesh
 from ..core.constants import (
     IARE, EDGE_FACES, FACE_EDGES, IDIR, LLONG, MG_BDY, MG_GEO, MG_REQ,
     MG_PARBDY, MG_REF)
-from .edges import EdgeTable, unique_edges, edge_lengths, unique_priority
+from .edges import (EdgeTable, unique_edges, edge_lengths, claim_channels,
+                    NEG_INF, PRI_MIN)
 
 _IARE_J = jnp.asarray(IARE)
 
@@ -61,13 +62,17 @@ def split_wave(mesh: Mesh, met: jax.Array, lmax: float = LLONG,
     vb = jnp.clip(et.ev[:, 1], 0, capP - 1)
     frozen_edge = (et.etag & (MG_REQ | MG_PARBDY)) != 0
     cand = et.emask & (lens > lmax) & ~frozen_edge
-    pri = unique_priority(lens, cand)                 # [capE]
+    s, t = claim_channels(lens, cand)                 # sort-free priority
 
-    # --- nomination: each tet picks its highest-priority candidate edge --
-    tet_edge_pri = pri[et.edge_id]                    # [capT,6]
-    tet_edge_pri = jnp.where(mesh.tmask[:, None], tet_edge_pri, 0)
-    best = jnp.max(tet_edge_pri, axis=1)              # [capT]
-    nominate = (tet_edge_pri == best[:, None]) & (best[:, None] > 0)
+    # --- nomination: each tet picks its (s,t)-max candidate edge ---------
+    tes = jnp.where(mesh.tmask[:, None], s[et.edge_id], NEG_INF)
+    best_s = jnp.max(tes, axis=1)                     # [capT]
+    at_best = (tes == best_s[:, None]) & jnp.isfinite(best_s)[:, None]
+    tet_t = jnp.where(at_best, t[et.edge_id], PRI_MIN)
+    best_t = jnp.max(tet_t, axis=1)
+    # exactly one slot per tet (t is unique): the whole-shell win test
+    # below stays exact under simultaneous application
+    nominate = at_best & (tet_t == best_t[:, None])
 
     # --- an edge wins iff nominated by its whole shell -------------------
     capE = et.ev.shape[0]
@@ -121,10 +126,12 @@ def split_wave(mesh: Mesh, met: jax.Array, lmax: float = LLONG,
     eid = et.edge_id[jnp.arange(capT), loc_e]              # unique edge id
     m_id = jnp.clip(mid_id[eid], 0, capP - 1)              # midpoint vid
 
-    # rank of this tet within its shell -> new tet slot
-    # order within shell: by tet id (scatter-add trick: stable prefix)
-    # compute per-tet slot = tet_off[eid] + (rank of tet among shell tets)
-    shell_rank = _rank_within_groups(eid, has, capE)
+    # rank of this tet within its shell -> new tet slot.  A winning edge is
+    # nominated by its WHOLE shell, so the shell tets of a winning edge are
+    # exactly the tets whose chosen slot maps to it — the shell rank
+    # precomputed by unique_edges (sorted-segment rank, ascending tet id)
+    # is that rank, no extra sort needed.
+    shell_rank = et.shell_rank[jnp.arange(capT), loc_e]
     new_tid = (mesh.nelem + tet_off[eid] + shell_rank).astype(jnp.int32)
 
     i_loc = _IARE_J[loc_e, 0]                              # local idx of a
@@ -132,8 +139,9 @@ def split_wave(mesh: Mesh, met: jax.Array, lmax: float = LLONG,
     tvert = mesh.tet
     ar = jnp.arange(capT)
     # tet1 (in place): vertex j -> m ; tet2 (new slot): vertex i -> m
-    tet1 = tvert.at[ar, j_loc].set(jnp.where(has, m_id, tvert[ar, j_loc]))
-    tet2_rows = tvert.at[ar, i_loc].set(m_id)              # full rows
+    tet1 = tvert.at[ar, j_loc].set(jnp.where(has, m_id, tvert[ar, j_loc]),
+                                   unique_indices=True)
+    tet2_rows = tvert.at[ar, i_loc].set(m_id, unique_indices=True)
     tet_out = _scatter_rows(tet1, new_tid, tet2_rows, has)
     tmask = _scatter_rows(mesh.tmask, new_tid,
                           jnp.ones(new_tid.shape[0], bool), has)
@@ -164,28 +172,13 @@ def _scatter_rows(dst, idx, rows, mask):
 
     ``mode="drop"`` gives a race-free masked scatter: rows with mask False
     are sent out of bounds and discarded, so no identity-write can collide
-    with a real write on the same slot.
+    with a real write on the same slot.  Every caller's live targets are
+    unique by construction (midpoint slots / new-tet slots are allocated
+    by prefix sums), so the scatter is declared unique — on TPU this lets
+    XLA vectorize it instead of assuming write conflicts.
     """
     safe = jnp.where(mask, idx, dst.shape[0])
-    return dst.at[safe].set(rows, mode="drop")
-
-
-def _rank_within_groups(gid: jax.Array, mask: jax.Array, ngroups: int):
-    """rank of element i among elements with the same gid (masked), by index.
-
-    Sort-based: stable sort by gid keeps index order within groups.
-    """
-    n = gid.shape[0]
-    key = jnp.where(mask, gid, ngroups)
-    order = jnp.argsort(key, stable=True)
-    ks = key[order]
-    first = jnp.concatenate([jnp.array([True]), ks[1:] != ks[:-1]])
-    pos = jnp.arange(n)
-    head = jnp.where(first, pos, 0)
-    head = jax.lax.associative_scan(jnp.maximum, head)
-    rank_sorted = pos - head
-    rank = jnp.zeros(n, jnp.int32).at[order].set(rank_sorted.astype(jnp.int32))
-    return rank
+    return dst.at[safe].set(rows, mode="drop", unique_indices=True)
 
 
 def _split_tags(mesh: Mesh, loc_e, i_loc, j_loc, has):
@@ -209,8 +202,10 @@ def _split_tags(mesh: Mesh, loc_e, i_loc, j_loc, has):
         ftag = mesh.ftag
         fref = mesh.fref
         # cut face = face opposite `kept` -> interior
-        ftag = ftag.at[ar, kept].set(jnp.where(has, 0, ftag[ar, kept]))
-        fref = fref.at[ar, kept].set(jnp.where(has, 0, fref[ar, kept]))
+        ftag = ftag.at[ar, kept].set(jnp.where(has, 0, ftag[ar, kept]),
+                                     unique_indices=True)
+        fref = fref.at[ar, kept].set(jnp.where(has, 0, fref[ar, kept]),
+                                     unique_indices=True)
         # edges: for each local edge, decide inheritance
         etag = mesh.etag
         # new edges: edges incident to `repl` other than the split edge now

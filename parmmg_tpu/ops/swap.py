@@ -20,7 +20,7 @@ import jax.numpy as jnp
 
 from ..core.mesh import Mesh
 from ..core.constants import EPSD, QUAL_FLOOR
-from .edges import unique_edges, unique_priority
+from .edges import unique_edges, claim_channels, NEG_INF, PRI_MIN
 from .quality import quality_from_points
 
 SWAP_GAIN = 1.053
@@ -113,14 +113,22 @@ def swap32_wave(mesh: Mesh, met: jax.Array) -> SwapResult:
     q_new = jnp.minimum(qual(new_a), qual(new_b))
     cand = cand & (q_new > jnp.maximum(SWAP_GAIN * q_old, QUAL_FLOOR))
 
-    # --- claims: the 3 shell tets, exclusively ---------------------------
-    pri = unique_priority(q_new - q_old, cand)
-    tclaim = jnp.zeros(capT + 1, jnp.int32)
-    for s, t in ((s0, t0), (s1, t1), (s2, t2)):
-        tclaim = tclaim.at[jnp.where(cand, s, capT)].max(pri, mode="drop")
-    win = cand
-    for s in (s0, s1, s2):
-        win = win & (tclaim[s] == pri)
+    # --- claims: the 3 shell tets, exclusively (two-channel sort-free) ---
+    ps, pt = claim_channels(q_new - q_old, cand)
+    cl_s = jnp.full(capT + 1, NEG_INF)
+    for sh in (s0, s1, s2):
+        cl_s = cl_s.at[jnp.where(cand, sh, capT)].max(ps, mode="drop")
+    eq = cand
+    for sh in (s0, s1, s2):
+        eq = eq & (ps == cl_s[sh])
+    cl_t = jnp.full(capT + 1, PRI_MIN)
+    for sh in (s0, s1, s2):
+        cl_t = cl_t.at[jnp.where(eq, sh, capT)].max(pt, mode="drop")
+    # winners are pairwise shell-disjoint: two winners sharing a tet would
+    # both be that tet's pooled (s,t)-max — impossible, t is unique
+    win = eq
+    for sh in (s0, s1, s2):
+        win = win & (pt == cl_t[sh])
 
     # --- apply: overwrite slots t0,t1; kill t2 ---------------------------
     tet = mesh.tet
@@ -215,13 +223,16 @@ def swap23_wave(mesh: Mesh, met: jax.Array) -> SwapResult:
     q_new = jnp.minimum(jnp.minimum(qual(n1), qual(n2)), qual(n3))
     cand = cand & pos & (q_new > jnp.maximum(SWAP_GAIN * q_old, QUAL_FLOOR))
 
-    # --- capacity for the third tet --------------------------------------
-    pri = unique_priority(q_new - q_old, cand)
-    # claims on both tets
-    tclaim = jnp.zeros(capT + 1, jnp.int32)
-    tclaim = tclaim.at[jnp.where(cand, t1, capT)].max(pri, mode="drop")
-    tclaim = tclaim.at[jnp.where(cand, t2, capT)].max(pri, mode="drop")
-    win = cand & (tclaim[t1] == pri) & (tclaim[t2] == pri)
+    # --- claims on both tets (two-channel sort-free) ---------------------
+    ps, pt = claim_channels(q_new - q_old, cand)
+    cl_s = jnp.full(capT + 1, NEG_INF)
+    cl_s = cl_s.at[jnp.where(cand, t1, capT)].max(ps, mode="drop")
+    cl_s = cl_s.at[jnp.where(cand, t2, capT)].max(ps, mode="drop")
+    eq = cand & (ps == cl_s[t1]) & (ps == cl_s[t2])
+    cl_t = jnp.full(capT + 1, PRI_MIN)
+    cl_t = cl_t.at[jnp.where(eq, t1, capT)].max(pt, mode="drop")
+    cl_t = cl_t.at[jnp.where(eq, t2, capT)].max(pt, mode="drop")
+    win = eq & (pt == cl_t[t1]) & (pt == cl_t[t2])
     w_i = win.astype(jnp.int32)
     off = jnp.cumsum(w_i) - w_i
     fits = off < (capT - mesh.nelem)

@@ -1,0 +1,94 @@
+"""Phase-level wall-clock profile of one adapt cycle on the live device.
+
+Times each sub-operator (edge table, lengths, split, adjacency, collapse,
+swaps, smooth) with block_until_ready, after a compile warm-up, to show
+where an adapt cycle's time goes.  Run: python scripts/profile_adapt.py [N]
+"""
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+_cache = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                      "..", ".jax_cache")
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", _cache)
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from parmmg_tpu.core.mesh import make_mesh
+from parmmg_tpu.ops import adjacency as adj
+from parmmg_tpu.ops.adapt import adapt_cycle
+from parmmg_tpu.ops.analysis import analyze_mesh
+from parmmg_tpu.ops.collapse import collapse_wave
+from parmmg_tpu.ops.edges import unique_edges, edge_lengths, unique_priority
+from parmmg_tpu.ops.smooth import smooth_wave
+from parmmg_tpu.ops.split import split_wave
+from parmmg_tpu.ops.swap import swap23_wave, swap32_wave
+from parmmg_tpu.utils.fixtures import cube_mesh, analytic_iso_metric
+
+
+def timeit(label, fn, *args, reps=3, **kw):
+    jfn = jax.jit(fn, **kw)
+    out = jfn(*args)
+    jax.block_until_ready(out)          # compile + warm
+    ts = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        out = jfn(*args)
+        jax.block_until_ready(out)
+        ts.append(time.perf_counter() - t0)
+    print(f"  {label:28s} {min(ts)*1e3:9.2f} ms")
+    return out
+
+
+def main():
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 16
+    vert, tet = cube_mesh(n)
+    mesh = make_mesh(vert, tet, capP=3 * len(vert), capT=3 * len(tet))
+    mesh = analyze_mesh(mesh).mesh
+    h = analytic_iso_metric(vert, "shock", h=1.5 / n)
+    met = jnp.zeros(mesh.capP, mesh.vert.dtype).at[: len(h)].set(
+        jnp.asarray(h, mesh.vert.dtype)).at[len(h):].set(1.0)
+    print(f"N={n}: {len(tet)} tets, capT={mesh.capT}, capP={mesh.capP}, "
+          f"device={jax.devices()[0].platform}")
+
+    # NOTE: every prep value is produced by a jitted call — eager array
+    # code on the tunneled backend pays a transport round trip PER OP
+    et = timeit("unique_edges", unique_edges, mesh)
+    lens = timeit("edge_lengths", edge_lengths, mesh, et, met)
+    timeit("unique_priority", unique_priority, lens, et.emask)
+    timeit("split_wave", lambda m, k: split_wave(m, k), mesh, met)
+    timeit("build_adjacency", adj.build_adjacency, mesh)
+    timeit("collapse_wave", lambda m, k: collapse_wave(m, k), mesh, met)
+    timeit("boundary_edge_tags", adj.boundary_edge_tags, mesh)
+    timeit("swap32_wave", lambda m, k: swap32_wave(m, k), mesh, met)
+    timeit("swap23_wave", lambda m, k: swap23_wave(m, k), mesh, met)
+    timeit("smooth_wave", lambda m, k: smooth_wave(m, k), mesh, met)
+
+    # full cycles, as bench runs them.  adapt_cycle DONATES its inputs, so
+    # deep-copy the state before each flavor (and time the second call —
+    # the first may absorb a compile or a transport stall)
+    m1, k1, c = adapt_cycle(mesh, met, jnp.asarray(0, jnp.int32))
+    jax.block_until_ready(c)
+    for do_swap in (True, False):
+        for rep in range(2):
+            m = jax.tree.map(jnp.copy, m1)
+            k = jnp.copy(k1)
+            jax.block_until_ready(k)
+            t0 = time.perf_counter()
+            m, k, c = adapt_cycle(m, k, jnp.asarray(1, jnp.int32),
+                                  do_swap=do_swap)
+            np.asarray(c)
+            dt = time.perf_counter() - t0
+        print(f"  adapt_cycle(do_swap={do_swap!s:5}) "
+              f"{dt*1e3:9.2f} ms  counts={np.asarray(c)[:5]}")
+
+
+if __name__ == "__main__":
+    main()

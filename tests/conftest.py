@@ -13,13 +13,17 @@ if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
 
-# persistent compile cache: the wave kernels are large XLA graphs; caching
-# across pytest processes cuts minutes per run.  MUST be set before the
-# first `import jax` — jax reads these env vars at config-init time.
-_CACHE_DIR = os.path.join(os.path.dirname(__file__), "..", ".jax_cache")
-os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", _CACHE_DIR)
-os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
-os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "0")
+# NO persistent compile cache for the CPU test matrix: on this image the
+# XLA:CPU AOT cache is unreliable — serialize() intermittently SIGABRTs
+# inside put_executable_and_time, and reloading entries warns about
+# machine-feature mismatches (+prefer-no-scatter) that "could lead to
+# SIGILL" (cpu_aot_loader.cc).  Set JAX_COMPILATION_CACHE_DIR explicitly
+# to opt back in; the TPU bench path keeps its own cache (bench.py).
+if os.environ.get("PARMMG_TEST_CACHE", "") == "1":
+    _CACHE_DIR = os.path.join(os.path.dirname(__file__), "..", ".jax_cache")
+    os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", _CACHE_DIR)
+    os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
+    os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "0")
 
 # The environment may pre-register a real-TPU tunnel backend ("axon") via
 # sitecustomize at interpreter startup; its lazy client creation blocks for
@@ -33,8 +37,10 @@ try:  # pragma: no cover - environment-specific
 except Exception:
     pass
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_compilation_cache_dir",
-                  os.environ["JAX_COMPILATION_CACHE_DIR"])
-jax.config.update(
-    "jax_persistent_cache_min_compile_time_secs",
-    float(os.environ["JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS"]))
+if os.environ.get("JAX_COMPILATION_CACHE_DIR"):
+    jax.config.update("jax_compilation_cache_dir",
+                      os.environ["JAX_COMPILATION_CACHE_DIR"])
+    jax.config.update(
+        "jax_persistent_cache_min_compile_time_secs",
+        float(os.environ.get(
+            "JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")))

@@ -132,8 +132,28 @@ def test_iparam_dparam_surface():
     assert pm.info.niter == 2
     assert pm.info.api_mode == C.APIDISTRIB_NODES
     assert pm.info.hmin == 0.01
-    with pytest.raises(KeyError):
-        pm.set_iparameter(IParam.lag, 1)
+    # lagrangian / level-set are settable but refused at run() time with a
+    # strong failure, like the reference's PMMG_check_inputData
+    # (libparmmg.c:69-81)
+    pm.set_iparameter(IParam.lag, 1)
+    assert pm.info.lag == 1
+
+
+def test_unavailable_inputs_rejected_at_run():
+    import numpy as np
+    pm = ParMesh()
+    vert = np.array([[0, 0, 0], [1, 0, 0], [0, 1, 0], [0, 0, 1],
+                     [1, 1, 1.]])
+    tets = np.array([[1, 2, 3, 4], [2, 3, 4, 5]])
+    pm.set_mesh_size(np_=len(vert), ne=len(tets))
+    pm.set_vertices(vert)
+    pm.set_tetrahedra(tets)
+    pm.info.niter = 1
+    pm.set_iparameter(IParam.lag, 0)
+    assert pm.run() == C.PMMG_STRONGFAILURE
+    pm.set_iparameter(IParam.lag, -1)
+    pm.set_iparameter(IParam.iso, 1)
+    assert pm.run() == C.PMMG_STRONGFAILURE
 
 
 def test_node_communicator_api_roundtrip():
