@@ -87,12 +87,24 @@ def main() -> None:
     met = jnp.zeros(mesh.capP, mesh.vert.dtype).at[: len(h)].set(
         jnp.asarray(h, mesh.vert.dtype)).at[len(h):].set(1.0)
 
-    # warm-up: compile the fused block (also performs one block of real
-    # adaptation work, which is fine — the timed phase measures steady
-    # state)
+    # block schedule: global cycle indices keep the swap cadence identical
+    # to the unfused host driver (swap every 3rd global cycle)
+    sched = []
+    b = 0
+    while b < cycles:
+        nc = min(block, cycles - b)
+        sched.append((b, nc, (block + b) % 3))
+        b += nc
+
+    # warm-up: run one block (real work), then AOT-compile every other
+    # distinct flavor so no compilation lands inside the timed loop
     m1, k1, wcnt = adapt_cycles_fused(mesh, met, jnp.asarray(0, jnp.int32),
                                       n_cycles=block, swap_every=3)
     jax.block_until_ready(wcnt)
+    for nc, off in {(nc, off) for _, nc, off in sched} - {(block, 0)}:
+        adapt_cycles_fused.lower(
+            m1, k1, jnp.asarray(0, jnp.int32), n_cycles=nc,
+            swap_every=3, swap_offset=off).compile()
 
     # timed loop: cycles run in fused blocks of `block` (one dispatch +
     # ONE counter pull per block — on the tunneled chip every dispatch
@@ -102,12 +114,11 @@ def main() -> None:
     m, k = m1, k1
     live, times = [], []
     prev_live = ntet0
-    for b in range(0, cycles, block):
-        nc = min(block, cycles - b)
+    for b, nc, off in sched:
         t0 = time.perf_counter()
         m, k, counts = adapt_cycles_fused(
-            m, k, jnp.asarray(b + 1, jnp.int32), n_cycles=nc,
-            swap_every=3)
+            m, k, jnp.asarray(block + b, jnp.int32), n_cycles=nc,
+            swap_every=3, swap_offset=off)
         cs = np.asarray(counts)                   # blocks on this block
         times.append(time.perf_counter() - t0)
         # tets examined this block = sum over cycles of live-at-entry
@@ -123,6 +134,16 @@ def main() -> None:
               f"(transport stall)", file=sys.stderr)
 
     mtets_per_sec = total_tets / dt / 1e6
+
+    # bad-element polish before the quality report (part of the real
+    # pipeline — adapt_mesh runs it after convergence; not timed here
+    # because throughput is measured on the steady-state sizing cycles)
+    from parmmg_tpu.ops.adapt import sliver_polish
+    for w in range(3):
+        m, pc = sliver_polish(m, k, jnp.asarray(100 + w, jnp.int32))
+        if int(np.asarray(pc)[0]) == 0 and int(np.asarray(pc)[1]) == 0:
+            break
+
     q = np.asarray(tet_quality(m))
     tm = np.asarray(m.tmask)
     qmin = float(q[tm].min()) if tm.any() else 0.0

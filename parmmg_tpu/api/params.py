@@ -113,6 +113,16 @@ class Info:
     # devices
     n_devices: int = 1
 
+    def angedg(self) -> float:
+        """Ridge-detection threshold as a cosine: cos(angle_deg), or the
+        'never a ridge' sentinel -1.1 when detection is off (-nr).  The
+        single source of truth for initial analysis and mid-adaptation
+        re-analysis."""
+        import math
+        if not self.angle_detection:
+            return -1.1
+        return math.cos(math.radians(self.angle_deg))
+
     def set_iparameter(self, key: IParam, val: int) -> None:
         m = {
             IParam.verbose: ("imprim", int),

@@ -398,9 +398,7 @@ class ParMesh:
                          vref=self.vref, tref=self.tref,
                          capP=capP, capT=capT)
         # geometric analysis first (ridges/corners/normals from dihedrals)
-        mesh = analyze_mesh(
-            mesh, angedg=np.cos(np.deg2rad(self.info.angle_deg))
-            if self.info.angle_detection else -1.1).mesh
+        mesh = analyze_mesh(mesh, angedg=self.info.angedg()).mesh
 
         # overlay user-required / corner / ridge flags
         vtag = np.array(np.asarray(mesh.vtag), copy=True)
@@ -650,6 +648,11 @@ class ParMesh:
         feat = live & ((etag & (C.MG_GEO | C.MG_REQ | C.MG_REF)) != 0)
         e = np.sort(ev[feat], axis=1)
         tags = etag[feat]
+        if len(e) == 0:                     # e.g. -nr on a smooth surface
+            self._out_edges_cache = (
+                np.zeros((0, 2), np.int64), np.zeros(0, np.int32),
+                np.zeros(0, bool), np.zeros(0, bool))
+            return self._out_edges_cache
         key = e[:, 0].astype(np.int64) << 32 | e[:, 1]
         o = np.argsort(key, kind="stable")
         key, e, tags = key[o], e[o], tags[o]

@@ -64,6 +64,20 @@ def parmmg_run(pm) -> tuple[Mesh, object, AdaptStats]:
     tim = Timers()
     with tim("analysis"):
         mesh, met = pm._build_core_mesh()
+    if info.nosurf:
+        # -nosurf: no surface modification — freeze every boundary entity
+        # with MG_REQ (exactly how the reference freezes parallel faces,
+        # and how Mmg interprets nosurf: required boundary)
+        import jax.numpy as jnp
+        import dataclasses
+        bdy_f = (mesh.ftag & C.MG_BDY) != 0
+        bdy_e = (mesh.etag & C.MG_BDY) != 0
+        bdy_v = (mesh.vtag & C.MG_BDY) != 0
+        mesh = dataclasses.replace(
+            mesh,
+            ftag=jnp.where(bdy_f, mesh.ftag | C.MG_REQ, mesh.ftag),
+            etag=jnp.where(bdy_e, mesh.etag | C.MG_REQ, mesh.etag),
+            vtag=jnp.where(bdy_v, mesh.vtag | C.MG_REQ, mesh.vtag))
     with tim("metric"):
         met = build_metric(mesh, met, info)
 
@@ -79,13 +93,16 @@ def parmmg_run(pm) -> tuple[Mesh, object, AdaptStats]:
         bg_mesh = None
 
     stats = AdaptStats()
+    angedg = info.angedg()
     if info.n_devices <= 1:
         niter = max(1, info.niter)
         for it in range(niter):
             with tim(f"adaptation"):
                 mesh, met, st = adapt_mesh(
                     mesh, met,
-                    verbose=3 if info.imprim >= C.PMMG_VERB_ITWAVES else 0)
+                    verbose=3 if info.imprim >= C.PMMG_VERB_ITWAVES else 0,
+                    noinsert=info.noinsert, noswap=info.noswap,
+                    nomove=info.nomove, angedg=angedg)
             stats += st
     else:
         from .parallel.dist import distributed_adapt
@@ -98,8 +115,9 @@ def parmmg_run(pm) -> tuple[Mesh, object, AdaptStats]:
                 mesh, met, part = distributed_adapt(
                     mesh, met, info.n_devices, part=part,
                     verbose=3 if info.imprim >= C.PMMG_VERB_ITWAVES else 0,
-                    stats=stats)
-                mesh = analyze_mesh(mesh).mesh
+                    stats=stats, noinsert=info.noinsert,
+                    noswap=info.noswap, nomove=info.nomove)
+                mesh = analyze_mesh(mesh, angedg).mesh
             if it + 1 < niter and not info.nobalancing \
                     and info.repartitioning == C.REPART_IFC_DISPLACEMENT:
                 # displace old interfaces into shard interiors so the
@@ -110,6 +128,26 @@ def parmmg_run(pm) -> tuple[Mesh, object, AdaptStats]:
                                            nlayers=info.ifc_layers)
             elif it + 1 < niter:
                 part = None          # fresh graph partition next iter
+        # bad-element optimization on the merged mesh (same contract as
+        # the single-device path: sliver_polish after the sizing loop)
+        if not (info.noinsert and info.noswap and info.nomove):
+            from .ops.adapt import sliver_polish
+            import jax.numpy as jnp
+            with tim("bad-element polish"):
+                for w in range(4):
+                    mesh, counts = sliver_polish(
+                        mesh, met, jnp.asarray(1000 + w, jnp.int32),
+                        do_collapse=not info.noinsert,
+                        do_swap=not info.noswap,
+                        do_smooth=not info.nomove)
+                    pc = np.asarray(counts)
+                    stats.ncollapse += int(pc[0])
+                    stats.nswap += int(pc[1])
+                    stats.nmoved += int(pc[2])
+                    if int(pc[0]) + int(pc[1]) > 0:
+                        part = None   # tet set changed: labels are stale
+                    if int(pc[0]) == 0 and int(pc[1]) == 0:
+                        break
         pm._out_part = part          # reused by distributed output
 
     # interpolate user fields old mesh -> new mesh

@@ -54,7 +54,7 @@ class AdaptStats:
 
 def adapt_cycle_impl(mesh: Mesh, met: jax.Array, wave: jax.Array,
                      do_swap: bool = True, do_smooth: bool = True,
-                     smooth_waves: int = 1):
+                     smooth_waves: int = 1, do_insert: bool = True):
     """One adaptation cycle: split -> collapse -> [swap] -> [smooth].
 
     Pure jittable function (jitted wrapper below) — also the compile-check
@@ -67,22 +67,30 @@ def adapt_cycle_impl(mesh: Mesh, met: jax.Array, wave: jax.Array,
     remote-device transport, and an *eager* count op on the host would
     fight the donated input buffers).
     """
-    res = split_wave(mesh, met)
-    mesh, met = res.mesh, res.met
-    mesh = build_adjacency(mesh)
-    nsplit, overflow = res.nsplit, res.overflow
+    if do_insert:
+        res = split_wave(mesh, met)
+        mesh, met = res.mesh, res.met
+        mesh = build_adjacency(mesh)
+        nsplit, overflow = res.nsplit, res.overflow
 
-    col = collapse_wave(mesh, met)
-    mesh = col.mesh
-    mesh = build_adjacency(mesh)
-    # collapse rewires the surface (dying tets' face tags transfer to the
-    # surviving neighbors); re-propagate MG_BDY from faces to their edges
-    # and vertices so later splits/smooth treat the new surface entities
-    # as boundary — without this, untagged surface midpoints become
-    # "movable" and smoothing dents the surface
-    from .adjacency import boundary_edge_tags
-    mesh = boundary_edge_tags(mesh)
-    ncol = col.ncollapse
+        col = collapse_wave(mesh, met)
+        mesh = col.mesh
+        mesh = build_adjacency(mesh)
+        # collapse rewires the surface (dying tets' face tags transfer to
+        # the surviving neighbors); re-propagate MG_BDY from faces to
+        # their edges and vertices so later splits/smooth treat the new
+        # surface entities as boundary — without this, untagged surface
+        # midpoints become "movable" and smoothing dents the surface
+        from .adjacency import boundary_edge_tags
+        mesh = boundary_edge_tags(mesh)
+        ncol = col.ncollapse
+    else:
+        # -noinsert: no point insertion or deletion (Mmg contract); keep
+        # the adjacency fresh for the swap/smooth waves
+        mesh = build_adjacency(mesh)
+        nsplit = jnp.zeros((), jnp.int32)
+        ncol = jnp.zeros((), jnp.int32)
+        overflow = jnp.zeros((), bool)
 
     nswap = jnp.zeros((), jnp.int32)
     if do_swap:
@@ -106,12 +114,13 @@ def adapt_cycle_impl(mesh: Mesh, met: jax.Array, wave: jax.Array,
 
 
 adapt_cycle = partial(jax.jit, static_argnames=(
-    "do_swap", "do_smooth", "smooth_waves"),
+    "do_swap", "do_smooth", "smooth_waves", "do_insert"),
     donate_argnums=(0, 1))(adapt_cycle_impl)
 
 
 def adapt_cycles_fused_impl(mesh: Mesh, met: jax.Array, wave0: jax.Array,
-                            n_cycles: int = 3, swap_every: int = 3):
+                            n_cycles: int = 3, swap_every: int = 3,
+                            swap_offset: int = 0):
     """``n_cycles`` adaptation cycles in ONE jitted program.
 
     On a remote-attached TPU every dispatch pays a transport round trip
@@ -128,7 +137,10 @@ def adapt_cycles_fused_impl(mesh: Mesh, met: jax.Array, wave0: jax.Array,
     """
     counts_all = []
     for c in range(n_cycles):
-        do_swap = (c % swap_every == swap_every - 1)
+        # cadence over the GLOBAL cycle index: callers running blocks of
+        # arbitrary size pass swap_offset = global_cycle0 % swap_every so
+        # the swap rhythm matches the unfused host driver exactly
+        do_swap = ((c + swap_offset) % swap_every == swap_every - 1)
         mesh, met, counts = adapt_cycle_impl(
             mesh, met, wave0 + c, do_swap=do_swap)
         counts_all.append(counts)
@@ -136,8 +148,48 @@ def adapt_cycles_fused_impl(mesh: Mesh, met: jax.Array, wave0: jax.Array,
 
 
 adapt_cycles_fused = partial(jax.jit, static_argnames=(
-    "n_cycles", "swap_every"),
+    "n_cycles", "swap_every", "swap_offset"),
     donate_argnums=(0, 1))(adapt_cycles_fused_impl)
+
+
+def sliver_polish_impl(mesh: Mesh, met: jax.Array, wave: jax.Array,
+                       sliver_q: float = 0.05, do_collapse: bool = True,
+                       do_swap: bool = True, do_smooth: bool = True):
+    """Bad-element optimization pass (MMG3D_opttyp analogue): quality-
+    targeted collapses on tets below ``sliver_q``, then swaps and a
+    smoothing wave.  Run after the sizing loop converges — length-driven
+    waves leave near-degenerate tets whose edges are all 'nice' lengths.
+    The do_* switches mirror -noinsert/-noswap/-nomove.
+
+    Returns (mesh, counts[4] = [ncollapse, nswap, nmoved, live_tets]).
+    """
+    from .adjacency import boundary_edge_tags
+    ncol = jnp.zeros((), jnp.int32)
+    nswap = jnp.zeros((), jnp.int32)
+    nmoved = jnp.zeros((), jnp.int32)
+    if do_collapse:
+        col = collapse_wave(mesh, met, sliver_q=sliver_q)
+        mesh = build_adjacency(col.mesh)
+        mesh = boundary_edge_tags(mesh)
+        ncol = col.ncollapse
+    if do_swap:
+        s32 = swap32_wave(mesh, met)
+        mesh = build_adjacency(s32.mesh)
+        s23 = swap23_wave(mesh, met)
+        mesh = build_adjacency(s23.mesh)
+        nswap = s32.nswap + s23.nswap
+    if do_smooth:
+        sm = smooth_wave(mesh, met, wave=wave)
+        mesh = sm.mesh
+        nmoved = sm.nmoved
+    counts = jnp.stack([ncol, nswap, nmoved,
+                        jnp.sum(mesh.tmask, dtype=jnp.int32)])
+    return mesh, counts
+
+
+sliver_polish = partial(jax.jit, static_argnames=(
+    "sliver_q", "do_collapse", "do_swap", "do_smooth"),
+    donate_argnums=(0,))(sliver_polish_impl)
 
 
 def grow_mesh_met(mesh: Mesh, met, newP: int, newT: int):
@@ -151,7 +203,9 @@ def grow_mesh_met(mesh: Mesh, met, newP: int, newT: int):
 
 def adapt_mesh(mesh: Mesh, met: jax.Array, max_cycles: int = 50,
                verbose: int = 0, headroom: float = 0.85,
-               swap_every: int = 3) -> tuple:
+               swap_every: int = 3, noinsert: bool = False,
+               noswap: bool = False, nomove: bool = False,
+               angedg: float | None = None) -> tuple:
     """Host driver: run cycles until no topological change, manage capacity.
 
     Swap waves cost about as much as split+collapse+smooth combined (they
@@ -164,7 +218,10 @@ def adapt_mesh(mesh: Mesh, met: jax.Array, max_cycles: int = 50,
     """
     stats = AdaptStats()
     from .analysis import analyze_mesh
-    mesh = analyze_mesh(mesh).mesh
+    from ..core.constants import ANGEDG
+    # honor the caller's ridge-detection threshold (-ar / -nr): a default
+    # re-analysis here would re-introduce MG_GEO tags the user disabled
+    mesh = analyze_mesh(mesh, ANGEDG if angedg is None else angedg).mesh
     quiet = 0
     for cycle in range(max_cycles):
         # capacity management before the wave
@@ -175,9 +232,11 @@ def adapt_mesh(mesh: Mesh, met: jax.Array, max_cycles: int = 50,
                                       max(mesh.capT, int(2 * n_t)))
             stats.regrows += 1
 
-        do_swap = (cycle % swap_every == swap_every - 1) or quiet > 0
+        do_swap = ((cycle % swap_every == swap_every - 1) or quiet > 0) \
+            and not noswap
         mesh, met, counts = adapt_cycle(
-            mesh, met, jnp.asarray(cycle, jnp.int32), do_swap=do_swap)
+            mesh, met, jnp.asarray(cycle, jnp.int32), do_swap=do_swap,
+            do_smooth=not nomove, do_insert=not noinsert)
         ns, nc, nw, nm, ovf, _ = (int(v) for v in np.asarray(counts))
         stats.nsplit += ns
         stats.ncollapse += nc
@@ -191,12 +250,32 @@ def adapt_mesh(mesh: Mesh, met: jax.Array, max_cycles: int = 50,
             mesh, met = grow_mesh_met(mesh, met, 2 * mesh.capP, 2 * mesh.capT)
             stats.regrows += 1
             continue
-        if ns == 0 and nc == 0 and (nw == 0 and do_swap):
+        if ns == 0 and nc == 0 and (noswap or (nw == 0 and do_swap)):
             quiet += 1
-            if quiet >= 2 or nm == 0:
+            if quiet >= 2 or nm == 0 or nomove:
                 break
-        elif ns == 0 and nc == 0 and not do_swap:
+        elif ns == 0 and nc == 0 and not do_swap and not noswap:
             quiet = max(quiet, 1)        # trigger a swap-inclusive cycle
         else:
             quiet = 0
+
+    # bad-element optimization: the sizing loop leaves slivers whose edge
+    # lengths are all in-range; polish until no sliver op applies
+    if noinsert and noswap and nomove:
+        return mesh, met, stats
+    for w in range(4):
+        mesh, counts = sliver_polish(mesh, met,
+                                     jnp.asarray(1000 + w, jnp.int32),
+                                     do_collapse=not noinsert,
+                                     do_swap=not noswap,
+                                     do_smooth=not nomove)
+        nc, nw, nm, _ = (int(v) for v in np.asarray(counts))
+        stats.ncollapse += nc
+        stats.nswap += nw
+        stats.nmoved += nm
+        if verbose >= 3:
+            print(f"  polish {w}: collapse {nc:5d} swap {nw:5d} "
+                  f"move {nm:5d}")
+        if nc == 0 and nw == 0:
+            break
     return mesh, met, stats

@@ -62,7 +62,18 @@ def _removable(vtag, other_vtag, edge_tag):
 
 
 def collapse_wave(mesh: Mesh, met: jax.Array, lmin: float = LSHRT,
-                  lmax: float = LLONG) -> CollapseResult:
+                  lmax: float = LLONG,
+                  sliver_q: float | None = None) -> CollapseResult:
+    """One independent-set collapse wave.
+
+    Normal mode: contract edges shorter than ``lmin`` (Mmg's colver over
+    the short-edge cascade).  Sliver mode (``sliver_q`` set): target the
+    edges of tets whose quality is below ``sliver_q`` regardless of
+    length, and additionally require that the simulated collapse STRICTLY
+    improves the min quality over the removed vertex's ball — the batched
+    analogue of Mmg's bad-element optimization pass (``MMG3D_opttyp``
+    collapses on ``MMG3D_BADKAL`` elements).
+    """
     capT, capP = mesh.capT, mesh.capP
     et = unique_edges(mesh)
     lens = edge_lengths(mesh, et, met)
@@ -70,7 +81,19 @@ def collapse_wave(mesh: Mesh, met: jax.Array, lmin: float = LSHRT,
     vb = jnp.clip(et.ev[:, 1], 0, capP - 1)
 
     frozen_edge = (et.etag & (MG_REQ | MG_PARBDY)) != 0
-    short = et.emask & (lens < lmin) & ~frozen_edge
+    if sliver_q is None:
+        short = et.emask & (lens < lmin) & ~frozen_edge
+    else:
+        from .quality import quality_from_points
+        q_tet = quality_from_points(
+            mesh.vert[mesh.tet],
+            None if met.ndim == 1 else met[mesh.tet])
+        bad_tet = mesh.tmask & (q_tet < sliver_q)
+        bad_edge = jnp.zeros(et.ev.shape[0], bool).at[
+            et.edge_id.reshape(-1)].max(
+            jnp.repeat(bad_tet, 6), mode="drop")
+        # don't lengthen already-long edges by contracting into them
+        short = et.emask & bad_edge & ~frozen_edge & (lens < lmax)
 
     ta, tb = mesh.vtag[va], mesh.vtag[vb]
     rem_b = _removable(tb, ta, et.etag)      # can delete b (keep a)
@@ -146,6 +169,30 @@ def collapse_wave(mesh: Mesh, met: jax.Array, lmin: float = LSHRT,
         geombad = geombad.at[jnp.where(active, tv[:, k], capP)].max(
             bad, mode="drop")
     geombad = geombad[:capP] | newlong[:capP]
+
+    if sliver_q is not None:
+        # quality gate: the collapse must STRICTLY improve the min quality
+        # over the removed vertex's ball (dying tets drop out; surviving
+        # ball tets are evaluated at their simulated shape)
+        from .quality import quality_from_points
+        mq = None if met.ndim == 1 else met[tv]
+        ballq_old = jnp.full(capP + 1, jnp.inf)
+        for k in range(4):
+            idx = jnp.where(mesh.tmask, tv[:, k], capP)
+            ballq_old = ballq_old.at[idx].min(
+                jnp.where(mesh.tmask, q_tet, jnp.inf), mode="drop")
+        ballq_new = jnp.full(capP + 1, jnp.inf)
+        for k in range(4):
+            active = has_c[:, k] & mesh.tmask & ~contains_kept[:, k]
+            p = vpos.at[:, k].set(kept_pos[:, k])
+            mqk = None if mq is None else \
+                mq.at[:, k].set(met[kept[:, k]])
+            qk = quality_from_points(p, mqk)
+            ballq_new = ballq_new.at[
+                jnp.where(active, tv[:, k], capP)].min(
+                jnp.where(active, qk, jnp.inf), mode="drop")
+        improves = ballq_new[:capP] > ballq_old[:capP]
+        geombad = geombad | ~improves
 
     # --- claims (two-channel, sort-free) ---------------------------------
     # tet claim = (s,t)-max removal target over the 4 corners; a corner
@@ -235,8 +282,10 @@ def collapse_wave(mesh: Mesh, met: jax.Array, lmin: float = LSHRT,
     from .edges import segmented_or
     or_fwd = segmented_or(first, dtag)
     is_last = jnp.concatenate([first[1:], jnp.array([True])])
-    # per-segment total, scattered to the head slot then gathered by seg id
-    total_at_head = jnp.zeros(capT * 6 + 1, jnp.uint32).at[
+    # per-segment total, scattered to the head slot then gathered by seg
+    # id; buffer sized n6 exactly so the masked-out sentinel index n6 is
+    # genuinely out of bounds (dropped) — required for unique_indices
+    total_at_head = jnp.zeros(capT * 6, jnp.uint32).at[
         jnp.where(is_last, seg, capT * 6)].set(
         or_fwd, mode="drop", unique_indices=True)
     add_sorted = total_at_head[seg]                       # [capE] per slot

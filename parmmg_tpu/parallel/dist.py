@@ -52,7 +52,8 @@ def shard_stacked(stacked, dmesh: DeviceMesh):
     return jax.tree.map(lambda x: jax.device_put(x, sh), stacked)
 
 
-def dist_adapt_cycle(dmesh: DeviceMesh, do_swap: bool = True):
+def dist_adapt_cycle(dmesh: DeviceMesh, do_swap: bool = True,
+                     do_smooth: bool = True, do_insert: bool = True):
     """Build the jitted SPMD adapt step for a given device mesh.
 
     The per-shard body is the same ``adapt_cycle_impl`` as the single-chip
@@ -71,7 +72,8 @@ def dist_adapt_cycle(dmesh: DeviceMesh, do_swap: bool = True):
         mesh = _unstack(mesh_s)
         met = met_s[0]
         mesh, met, counts = adapt_cycle_impl(
-            mesh, met, wave, do_swap=do_swap, smooth_waves=2)
+            mesh, met, wave, do_swap=do_swap, do_smooth=do_smooth,
+            do_insert=do_insert, smooth_waves=2)
         ovf = jax.lax.pmax(counts[4], "shard")
         counts = jax.lax.psum(counts[:4], "shard")
         return _restack(mesh), met[None], counts, ovf
@@ -109,7 +111,9 @@ def dist_quality(dmesh: DeviceMesh):
 def distributed_adapt(mesh: Mesh, met, n_shards: int,
                       cycles: int = 10, dmesh: DeviceMesh | None = None,
                       partitioner: str = "morton", verbose: int = 0,
-                      part: np.ndarray | None = None, stats=None):
+                      part: np.ndarray | None = None, stats=None,
+                      noinsert: bool = False, noswap: bool = False,
+                      nomove: bool = False):
     """One outer remesh pass on n_shards devices (host driver).
 
     partition (or take the caller's displaced ``part``) -> freeze
@@ -136,8 +140,12 @@ def distributed_adapt(mesh: Mesh, met, n_shards: int,
         part = fix_contiguity(tet, part)
 
     cap_mult = 3.0
-    step_full = dist_adapt_cycle(dmesh, do_swap=True)
-    step_light = dist_adapt_cycle(dmesh, do_swap=False)
+    step_full = dist_adapt_cycle(dmesh, do_swap=not noswap,
+                                 do_smooth=not nomove,
+                                 do_insert=not noinsert)
+    step_light = dist_adapt_cycle(dmesh, do_swap=False,
+                                  do_smooth=not nomove,
+                                  do_insert=not noinsert)
     stacked = met_s = None
     c = 0
     regrows = 0

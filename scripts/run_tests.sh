@@ -1,0 +1,22 @@
+#!/usr/bin/env bash
+# Per-file test runner: one pytest process per test file.
+#
+# Why not one big `pytest tests/`: on this image the XLA:CPU compiler
+# intermittently segfaults (and its AOT serializer aborts) late in a
+# long-lived process after many hundred compilations — the same test
+# passes in a fresh process.  Per-file processes bound the blast radius
+# and mirror the reference CI, which runs each case as its own
+# executable under ctest (cmake/testing/pmmg_tests.cmake).
+set -u
+cd "$(dirname "$0")/.."
+fail=0
+for f in tests/test_*.py; do
+    echo "=== $f"
+    timeout 2000 python -m pytest "$f" -q --no-header 2>&1 | tail -2
+    rc=${PIPESTATUS[0]}
+    if [ "$rc" -ne 0 ]; then
+        echo "!!! $f exited $rc"
+        fail=1
+    fi
+done
+exit $fail

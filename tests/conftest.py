@@ -37,6 +37,23 @@ try:  # pragma: no cover - environment-specific
 except Exception:
     pass
 jax.config.update("jax_platforms", "cpu")
+
+# Free compiled executables between test modules: the XLA:CPU runtime on
+# this image becomes unstable after many hundred compilations in one
+# process (intermittent segfaults in backend_compile_and_load / aborts in
+# executable.serialize, always late in a long run; every test passes in a
+# fresh process).  Dropping the executable caches per module keeps the
+# process young.  scripts/run_tests.sh (one process per file) is the
+# belt-and-braces runner.
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _clear_jax_caches_between_modules():
+    yield
+    jax.clear_caches()
+
+
 if os.environ.get("JAX_COMPILATION_CACHE_DIR"):
     jax.config.update("jax_compilation_cache_dir",
                       os.environ["JAX_COMPILATION_CACHE_DIR"])

@@ -1,0 +1,87 @@
+"""Option-matrix tests — the reference CI exercises the remesher switches
+(-optim/-noinsert/-noswap/-nomove/-nosurf/-hsiz/-hgrad/-nr, see
+cmake/testing/pmmg_tests.cmake:72-150).  The reference only checks exit
+codes; here each switch's CONTRACT is asserted (ops suppressed, mesh
+valid, volume preserved)."""
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from parmmg_tpu.api import ParMesh, IParam, DParam
+from parmmg_tpu.core import constants as C
+from parmmg_tpu.core.mesh import tet_volumes
+from parmmg_tpu.utils.fixtures import cube_mesh
+
+
+def _staged(n=3, **info_kw):
+    vert, tet = cube_mesh(n)
+    pm = ParMesh()
+    pm.set_mesh_size(np_=len(vert), ne=len(tet))
+    pm.set_vertices(vert)
+    pm.set_tetrahedra(tet + 1)
+    pm.info.niter = 1
+    pm.info.imprim = -1
+    for k, v in info_kw.items():
+        setattr(pm.info, k, v)
+    return pm
+
+
+def _run_ok(pm):
+    assert pm.run() == C.PMMG_SUCCESS
+    vols = np.asarray(tet_volumes(pm._out))[np.asarray(pm._out.tmask)]
+    assert (vols > 0).all()
+    assert np.isclose(vols.sum(), 1.0, rtol=1e-4)
+    return pm
+
+
+def test_noinsert_keeps_point_count():
+    pm = _run_ok(_staged(noinsert=True, hsiz=0.2))
+    st = pm.stats
+    assert st.nsplit == 0 and st.ncollapse == 0
+    np_out, ne_out, *_ = pm.get_mesh_size()
+    assert np_out == len(cube_mesh(3)[0])      # no insertion or deletion
+
+
+def test_noswap_suppresses_swaps():
+    pm = _run_ok(_staged(noswap=True, hsiz=0.22))
+    assert pm.stats.nswap == 0
+    assert pm.stats.nsplit > 0                 # sizing still ran
+
+
+def test_nomove_suppresses_smoothing():
+    pm = _run_ok(_staged(nomove=True, hsiz=0.22))
+    assert pm.stats.nmoved == 0
+    assert pm.stats.nsplit > 0
+
+
+def test_nosurf_freezes_boundary_vertices():
+    pm = _staged(nosurf=True, hsiz=0.22)
+    vert0, _ = cube_mesh(3)
+    _run_ok(pm)
+    # every original boundary vertex must survive at its position
+    # (tolerance: core mesh coords are float32)
+    on_bdy = (np.isclose(vert0, 0) | np.isclose(vert0, 1)).any(axis=1)
+    out_v, _ = pm.get_vertices()
+    for v in vert0[on_bdy]:
+        d = np.linalg.norm(out_v - v[None, :], axis=1).min()
+        assert d < 1e-6, f"boundary vertex {v} moved/removed (d={d})"
+
+
+def test_optim_without_metric():
+    pm = _run_ok(_staged(optim=True))
+    assert pm.stats.cycles >= 1
+
+
+def test_hsiz_drives_target_size():
+    pm = _run_ok(_staged(hsiz=0.18))
+    _, ne_out, *_ = pm.get_mesh_size()
+    assert ne_out > len(cube_mesh(3)[1])       # refined vs 0.33 spacing
+
+
+def test_noridge_detection_flag():
+    pm = _staged(hsiz=0.3)
+    pm.info.angle_detection = False
+    _run_ok(pm)
+    # with -nr no MG_GEO ridge tags are produced on output feature edges
+    _, _, is_ridge, _ = pm.get_edges()
+    assert not is_ridge.any()

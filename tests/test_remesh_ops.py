@@ -166,3 +166,28 @@ def test_adapt_target_lengths():
     assert lens.max() < C.LLONG + 1e-4
     q = np.asarray(tet_quality(m))[np.asarray(m.tmask)]
     assert q.min() > 0.1
+
+
+def test_sliver_polish_improves_min_quality():
+    """The bad-element pass (sliver_polish) must raise the min quality of
+    a converged adaptation without breaking validity or volume — the
+    MMG3D_opttyp contract."""
+    from parmmg_tpu.ops.adapt import sliver_polish, adapt_cycle
+    m = _cube(3)
+    met = jnp.full(m.capP, 0.35, jnp.float32)
+    # a couple of sizing cycles leave a non-uniform state
+    for c in range(3):
+        m, met, _ = adapt_cycle(m, met, jnp.asarray(c, jnp.int32),
+                                do_swap=(c == 2))
+    q0 = np.asarray(tet_quality(m))
+    tm0 = np.asarray(m.tmask)
+    qmin0 = q0[tm0].min()
+    for w in range(3):
+        m, counts = sliver_polish(m, met, jnp.asarray(w, jnp.int32))
+        if int(np.asarray(counts)[0]) == 0 and \
+                int(np.asarray(counts)[1]) == 0:
+            break
+    m = _check_valid(m)                 # conforming + volume preserved
+    q1 = np.asarray(tet_quality(m))
+    tm1 = np.asarray(m.tmask)
+    assert q1[tm1].min() >= qmin0 - 1e-6
