@@ -106,6 +106,10 @@ class Info:
     hausd: float = C.HAUSD_DEFAULT
     hgrad: float = C.HGRAD_DEFAULT
     hgradreq: float = C.HGRADREQ_DEFAULT
+    # local (per-reference) parameters: (elt_type, ref, hmin, hmax, hausd)
+    # — the MMG3D_Set_localParameter / parsop surface the reference
+    # forwards per group (libparmmg_tools.c:573, API_functions 'nlocal')
+    local_params: list = dataclasses.field(default_factory=list)
     # I/O
     fmtout: str = "mesh"
     centralized_output: bool = True

@@ -240,6 +240,14 @@ class ParMesh:
     # ------------------------------------------------------------------
     # parameters
     # ------------------------------------------------------------------
+    def set_local_parameter(self, typ: int, ref: int, hmin: float,
+                            hmax: float, hausd: float) -> None:
+        """MMG3D_Set_localParameter analogue: size bounds applying only to
+        entities carrying surface reference ``ref``.  ``typ``: 1=triangle
+        (the only type the reference's parameter files use for 3D)."""
+        self.info.local_params.append(
+            (int(typ), int(ref), float(hmin), float(hmax), float(hausd)))
+
     def set_iparameter(self, key: IParam, val: int) -> None:
         self.info.set_iparameter(key, val)
 

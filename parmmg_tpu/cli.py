@@ -196,6 +196,24 @@ def main(argv=None) -> int:
     info.centralized_output = not args.dist_out
     info.noout = args.noout
 
+    # local-parameter file (<mesh>.mmg3d, MMG3D_parsop format; the
+    # reference delegates parsing to Mmg at libparmmg_tools.c:573)
+    parfile = Path(args.inp).with_suffix(".mmg3d")
+    if parfile.exists():
+        try:
+            parsed = _parse_parfile(parfile)
+        except (IndexError, ValueError) as e:
+            # the file is discovered implicitly by name — a stale or
+            # malformed one must not abort the run
+            print(f"  ## Warning: unable to parse {parfile} ({e}); "
+                  "local parameters ignored.", file=sys.stderr)
+            parsed = []
+        for typ, ref, hmin_l, hmax_l, hausd_l in parsed:
+            pm.set_local_parameter(typ, ref, hmin_l, hmax_l, hausd_l)
+        if args.verbose >= 1:
+            print(f"  %% {parfile} read: "
+                  f"{len(pm.info.local_params)} local parameter(s)")
+
     ret = pm.run()
     dt = time.perf_counter() - t0
     if ret != C.PMMG_SUCCESS:
@@ -208,6 +226,33 @@ def main(argv=None) -> int:
     if not args.noout:
         _save_outputs(pm, args)
     return 0
+
+
+def _parse_parfile(path):
+    """Parse an Mmg local-parameter file:
+
+        Parameters
+        <n>
+        <ref> <Triangle|Vertex|...> <hmin> <hmax> <hausd>
+
+    Returns [(typ, ref, hmin, hmax, hausd)], typ 1 for triangles (the
+    only local type meaningful for 3D surface references)."""
+    typ_map = {"triangle": 1, "triangles": 1, "vertex": 0, "vertices": 0}
+    out = []
+    lines = [ln.strip() for ln in path.read_text().splitlines()
+             if ln.strip() and not ln.strip().startswith("#")]
+    i = 0
+    while i < len(lines):
+        if lines[i].lower().startswith("parameters"):
+            n = int(lines[i + 1].split()[0])
+            for j in range(n):
+                tok = lines[i + 2 + j].split()
+                out.append((typ_map.get(tok[1].lower(), 1), int(tok[0]),
+                            float(tok[2]), float(tok[3]), float(tok[4])))
+            i += 2 + n
+        else:
+            i += 1
+    return out
 
 
 def _concat_shards(parts):

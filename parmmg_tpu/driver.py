@@ -48,9 +48,54 @@ def build_metric(mesh: Mesh, met, info):
     elif met is None or info.optim or info.optimLES:
         met = metric_optim(mesh)
     met = clamp_metric(met, hmin, hmax)
+    # local bounds BEFORE gradation (Mmg defsiz-then-gradsiz order) so the
+    # size jump at a ref-patch boundary is smoothed by -hgrad; re-applied
+    # after, since gradation only propagates smaller sizes and may pull a
+    # patch below its local hmin
+    if info.local_params:
+        met = apply_local_params(mesh, met, info)
     if info.hgrad > 0 and met.ndim == 1:
         met = gradation(mesh, met, hgrad=info.hgrad)
+    if info.local_params:
+        met = apply_local_params(mesh, met, info)
     return met
+
+
+def apply_local_params(mesh: Mesh, met, info):
+    """Per-reference size bounds (MMG3D_Set_localParameter / parsop file,
+    forwarded by the reference per group): vertices on boundary faces
+    carrying reference ``ref`` get their size clamped to the local
+    [hmin, hmax].  hausd (surface approximation distance) has no separate
+    role here — boundary faces are piecewise-linear and interface freezes
+    are tag-driven.  Iso: direct clamp; aniso: eigenvalue clamp of the
+    tensor (h = 1/sqrt(lambda))."""
+    import jax.numpy as jnp
+    from .core.constants import IDIR, MG_BDY
+
+    ftag = np.asarray(mesh.ftag)
+    fref = np.asarray(mesh.fref)
+    tet = np.asarray(mesh.tet)
+    tmask = np.asarray(mesh.tmask)
+    meth = np.array(np.asarray(met), copy=True)
+    for typ, ref, lhmin, lhmax, _hausd in info.local_params:
+        if typ != 1:          # only triangle-type locals exist in 3D
+            continue
+        sel_f = ((ftag & MG_BDY) != 0) & (fref == ref) & tmask[:, None]
+        vids = np.unique(np.concatenate(
+            [tet[sel_f[:, f]][:, IDIR[f]].reshape(-1) for f in range(4)]
+        )) if sel_f.any() else np.zeros(0, np.int64)
+        if not len(vids):
+            continue
+        if meth.ndim == 1:
+            meth[vids] = np.clip(meth[vids], lhmin, lhmax)
+        else:
+            from .ops.quality import unpack_sym
+            m = np.asarray(unpack_sym(jnp.asarray(meth[vids])))
+            w, v = np.linalg.eigh(m)
+            w = np.clip(w, 1.0 / lhmax ** 2, 1.0 / lhmin ** 2)
+            full = np.einsum("nij,nj,nkj->nik", v, w, v)
+            meth[vids] = full[:, [0, 0, 0, 1, 1, 2], [0, 1, 2, 1, 2, 2]]
+    return jnp.asarray(meth)
 
 
 def parmmg_run(pm) -> tuple[Mesh, object, AdaptStats]:

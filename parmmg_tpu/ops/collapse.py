@@ -170,27 +170,38 @@ def collapse_wave(mesh: Mesh, met: jax.Array, lmin: float = LSHRT,
             bad, mode="drop")
     geombad = geombad[:capP] | newlong[:capP]
 
-    if sliver_q is not None:
-        # quality gate: the collapse must STRICTLY improve the min quality
-        # over the removed vertex's ball (dying tets drop out; surviving
-        # ball tets are evaluated at their simulated shape)
-        from .quality import quality_from_points
-        mq = None if met.ndim == 1 else met[tv]
-        ballq_old = jnp.full(capP + 1, jnp.inf)
-        for k in range(4):
-            idx = jnp.where(mesh.tmask, tv[:, k], capP)
-            ballq_old = ballq_old.at[idx].min(
-                jnp.where(mesh.tmask, q_tet, jnp.inf), mode="drop")
-        ballq_new = jnp.full(capP + 1, jnp.inf)
-        for k in range(4):
-            active = has_c[:, k] & mesh.tmask & ~contains_kept[:, k]
-            p = vpos.at[:, k].set(kept_pos[:, k])
-            mqk = None if mq is None else \
-                mq.at[:, k].set(met[kept[:, k]])
-            qk = quality_from_points(p, mqk)
-            ballq_new = ballq_new.at[
-                jnp.where(active, tv[:, k], capP)].min(
-                jnp.where(active, qk, jnp.inf), mode="drop")
+    # --- ball-quality gate ----------------------------------------------
+    # Simulate the surviving ball of each removal target and compare min
+    # qualities (dying tets drop out).  Normal mode: the collapse must not
+    # degrade the ball min quality below 30% of its old value nor below
+    # the degeneracy floor (MMG5_colver's calnew/calold check — without
+    # it, aggressive coarsening flattens boundary regions into
+    # zero-volume slivers that interior-only swaps never repair).  Sliver
+    # mode: STRICT improvement (the pass exists to raise the min).
+    from .quality import quality_from_points
+    mq = None if met.ndim == 1 else met[tv]
+    if sliver_q is None:
+        q_tet = quality_from_points(vpos, mq)
+    ballq_old = jnp.full(capP + 1, jnp.inf)
+    for k in range(4):
+        idx = jnp.where(mesh.tmask, tv[:, k], capP)
+        ballq_old = ballq_old.at[idx].min(
+            jnp.where(mesh.tmask, q_tet, jnp.inf), mode="drop")
+    ballq_new = jnp.full(capP + 1, jnp.inf)
+    for k in range(4):
+        active = has_c[:, k] & mesh.tmask & ~contains_kept[:, k]
+        p = vpos.at[:, k].set(kept_pos[:, k])
+        mqk = None if mq is None else \
+            mq.at[:, k].set(met[kept[:, k]])
+        qk = quality_from_points(p, mqk)
+        ballq_new = ballq_new.at[
+            jnp.where(active, tv[:, k], capP)].min(
+            jnp.where(active, qk, jnp.inf), mode="drop")
+    if sliver_q is None:
+        ok = (ballq_new[:capP] >= 0.3 * ballq_old[:capP]) & \
+             (ballq_new[:capP] > QUAL_FLOOR)
+        geombad = geombad | ~ok
+    else:
         improves = ballq_new[:capP] > ballq_old[:capP]
         geombad = geombad | ~improves
 

@@ -74,6 +74,22 @@ def split_wave(mesh: Mesh, met: jax.Array, lmax: float = LLONG,
     # below stays exact under simultaneous application
     nominate = at_best & (tet_t == best_t[:, None])
 
+    # degeneracy veto (MMG5_split1b cavity-quality check): a tet refuses
+    # its nominated edge if either child tet would be degenerate — thin
+    # tets halved at a midpoint can round to exactly zero volume in f32
+    from .quality import quality_from_points
+    from ..core.constants import QUAL_FLOOR
+    ar0 = jnp.arange(capT)
+    loc_n = jnp.argmax(nominate, axis=1)                  # [capT]
+    e_n = et.edge_id[ar0, loc_n]
+    i_n = _IARE_J[loc_n, 0]
+    j_n = _IARE_J[loc_n, 1]
+    mid_n = 0.5 * (mesh.vert[va[e_n]] + mesh.vert[vb[e_n]])
+    pts = mesh.vert[mesh.tet]                             # [T,4,3]
+    q1 = quality_from_points(pts.at[ar0, j_n].set(mid_n))
+    q2 = quality_from_points(pts.at[ar0, i_n].set(mid_n))
+    nominate = nominate & ((q1 > QUAL_FLOOR) & (q2 > QUAL_FLOOR))[:, None]
+
     # --- an edge wins iff nominated by its whole shell -------------------
     capE = et.ev.shape[0]
     nom_count = jnp.zeros(capE, jnp.int32).at[et.edge_id.reshape(-1)].add(

@@ -143,9 +143,11 @@ def distributed_adapt(mesh: Mesh, met, n_shards: int,
     step_full = dist_adapt_cycle(dmesh, do_swap=not noswap,
                                  do_smooth=not nomove,
                                  do_insert=not noinsert)
-    step_light = dist_adapt_cycle(dmesh, do_swap=False,
-                                  do_smooth=not nomove,
-                                  do_insert=not noinsert)
+    # with -noswap both flavors are the same program: don't compile the
+    # multi-minute SPMD graph twice
+    step_light = step_full if noswap else dist_adapt_cycle(
+        dmesh, do_swap=False, do_smooth=not nomove,
+        do_insert=not noinsert)
     stacked = met_s = None
     c = 0
     regrows = 0

@@ -85,3 +85,36 @@ def test_noridge_detection_flag():
     # with -nr no MG_GEO ridge tags are produced on output feature edges
     _, _, is_ridge, _ = pm.get_edges()
     assert not is_ridge.any()
+
+
+def test_local_parameters_clamp_sizes():
+    """MMG3D_Set_localParameter path: vertices on surface ref 7 get the
+    local [hmin,hmax] clamp; elsewhere the global size applies."""
+    from parmmg_tpu.core.constants import IDIR
+    vert, tet = cube_mesh(3)
+    faces = []
+    for t in tet:
+        for f in range(4):
+            tri = t[IDIR[f]]
+            if (vert[tri][:, 2] == 0).all():
+                faces.append(tri + 1)
+    faces = np.array(faces)
+    pm = ParMesh()
+    pm.set_mesh_size(np_=len(vert), ne=len(tet), nt=len(faces))
+    pm.set_vertices(vert)
+    pm.set_tetrahedra(tet + 1)
+    pm.set_triangles(faces, refs=np.full(len(faces), 7))
+    pm.info.niter = 1
+    pm.info.imprim = -1
+    pm.set_met_size(1, len(vert))
+    pm.set_scalar_mets(np.full(len(vert), 0.4))
+    pm.set_local_parameter(1, 7, 0.05, 0.15, 0.001)
+    assert pm.run() == C.PMMG_SUCCESS
+    # output metric near z=0 must be clamped to the local hmax
+    out_v, _ = pm.get_vertices()
+    met = pm.get_metric()
+    near = np.isclose(out_v[:, 2], 0)
+    assert near.any()
+    assert met[near].max() <= 0.15 + 1e-5
+    far = out_v[:, 2] > 0.7
+    assert met[far].min() > 0.15
