@@ -237,7 +237,8 @@ def _parse_parfile(path):
 
     Returns [(typ, ref, hmin, hmax, hausd)], typ 1 for triangles (the
     only local type meaningful for 3D surface references)."""
-    typ_map = {"triangle": 1, "triangles": 1, "vertex": 0, "vertices": 0}
+    typ_map = {"triangle": 1, "triangles": 1,
+               "tetrahedron": 2, "tetrahedra": 2, "tetrahedrons": 2}
     out = []
     lines = [ln.strip() for ln in path.read_text().splitlines()
              if ln.strip() and not ln.strip().startswith("#")]
@@ -247,7 +248,13 @@ def _parse_parfile(path):
             n = int(lines[i + 1].split()[0])
             for j in range(n):
                 tok = lines[i + 2 + j].split()
-                out.append((typ_map.get(tok[1].lower(), 1), int(tok[0]),
+                typ = typ_map.get(tok[1].lower())
+                if typ is None:
+                    print(f"  ## Warning: unsupported local-parameter "
+                          f"type '{tok[1]}' in {path}; entry skipped.",
+                          file=sys.stderr)
+                    continue
+                out.append((typ, int(tok[0]),
                             float(tok[2]), float(tok[3]), float(tok[4])))
             i += 2 + n
         else:

@@ -56,8 +56,11 @@ def build_metric(mesh: Mesh, met, info):
         met = apply_local_params(mesh, met, info)
     if info.hgrad > 0 and met.ndim == 1:
         met = gradation(mesh, met, hgrad=info.hgrad)
-    if info.local_params:
-        met = apply_local_params(mesh, met, info)
+        # gradation only propagates smaller sizes and may pull a patch
+        # below its local hmin: re-apply the clamp (iso path only — the
+        # second pass is pointless when nothing changed met)
+        if info.local_params:
+            met = apply_local_params(mesh, met, info)
     return met
 
 
@@ -76,14 +79,21 @@ def apply_local_params(mesh: Mesh, met, info):
     fref = np.asarray(mesh.fref)
     tet = np.asarray(mesh.tet)
     tmask = np.asarray(mesh.tmask)
+    tref = np.asarray(mesh.tref)
     meth = np.array(np.asarray(met), copy=True)
     for typ, ref, lhmin, lhmax, _hausd in info.local_params:
-        if typ != 1:          # only triangle-type locals exist in 3D
+        if typ == 1:          # triangle locals: surface reference patch
+            sel_f = ((ftag & MG_BDY) != 0) & (fref == ref) & tmask[:, None]
+            vids = np.unique(np.concatenate(
+                [tet[sel_f[:, f]][:, IDIR[f]].reshape(-1)
+                 for f in range(4)]
+            )) if sel_f.any() else np.zeros(0, np.int64)
+        elif typ == 2:        # tetrahedron locals: volume sub-domain
+            sel_t = tmask & (tref == ref)
+            vids = np.unique(tet[sel_t].reshape(-1)) if sel_t.any() \
+                else np.zeros(0, np.int64)
+        else:
             continue
-        sel_f = ((ftag & MG_BDY) != 0) & (fref == ref) & tmask[:, None]
-        vids = np.unique(np.concatenate(
-            [tet[sel_f[:, f]][:, IDIR[f]].reshape(-1) for f in range(4)]
-        )) if sel_f.any() else np.zeros(0, np.int64)
         if not len(vids):
             continue
         if meth.ndim == 1:

@@ -272,19 +272,14 @@ def collapse_wave(mesh: Mesh, met: jax.Array, lmin: float = LSHRT,
     # donors of their OLD tag) and OR each key group's donor tags into
     # its receivers.
     from ..core.mesh import tet_edge_vertices
-    _I32MAX = 2147483647
+    from .edges import sort_pairs
     ev_new = tet_edge_vertices(new_tet).reshape(capT * 6, 2)
     ka = jnp.minimum(ev_new[:, 0], ev_new[:, 1])
     kb = jnp.maximum(ev_new[:, 0], ev_new[:, 1])
     alive_s = jnp.repeat(tmask, 6)
     donor_s = jnp.repeat(dead, 6)
     rel = alive_s | donor_s
-    ka = jnp.where(rel, ka, _I32MAX)
-    kb = jnp.where(rel, kb, _I32MAX)
-    order = jnp.lexsort((kb, ka))
-    ska, skb = ka[order], kb[order]
-    first = jnp.concatenate([jnp.array([True]),
-                             (ska[1:] != ska[:-1]) | (skb[1:] != skb[:-1])])
+    order, _, _, first = sort_pairs(ka, kb, rel, capP)
     seg = jax.lax.associative_scan(
         jnp.maximum, jnp.where(first, jnp.arange(capT * 6), 0))
     dtag = jnp.where(donor_s[order], mesh.etag.reshape(capT * 6)[order], 0)
