@@ -235,8 +235,9 @@ def _parse_parfile(path):
         <n>
         <ref> <Triangle|Vertex|...> <hmin> <hmax> <hausd>
 
-    Returns [(typ, ref, hmin, hmax, hausd)], typ 1 for triangles (the
-    only local type meaningful for 3D surface references)."""
+    Returns [(typ, ref, hmin, hmax, hausd)]: typ 1 = triangles (surface
+    reference patch), typ 2 = tetrahedra (volume sub-domain by tref);
+    other entity types warn and are skipped."""
     typ_map = {"triangle": 1, "triangles": 1,
                "tetrahedron": 2, "tetrahedra": 2, "tetrahedrons": 2}
     out = []

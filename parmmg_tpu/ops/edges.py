@@ -147,7 +147,13 @@ def unique_edges(mesh: Mesh) -> EdgeTable:
 
 
 def edge_lengths(mesh: Mesh, et: EdgeTable, met: jax.Array) -> jax.Array:
-    """[capE] metric length of each unique edge (garbage on dead slots)."""
+    """[capE] metric length of each unique edge (garbage on dead slots).
+
+    TPU lowering uses the fused Pallas kernels; every other platform the
+    jnp formula — selected per lowering platform (NOT per process
+    default backend, which may be a TPU plugin while this computation
+    lowers for CPU devices)."""
+    from functools import partial
     from .quality import edge_length_iso, edge_length_ani
     from .pallas_kernels import (use_pallas, edge_length_iso_pallas,
                                  edge_length_ani_pallas)
@@ -157,10 +163,16 @@ def edge_lengths(mesh: Mesh, et: EdgeTable, met: jax.Array) -> jax.Array:
     i1 = jnp.clip(et.ev[:, 1], 0, mesh.capP - 1)
     if met.ndim == 1:
         if use_pallas():
-            return edge_length_iso_pallas(p0, p1, met[i0], met[i1])
+            return jax.lax.platform_dependent(
+                p0, p1, met[i0], met[i1],
+                tpu=partial(edge_length_iso_pallas, interpret=False),
+                default=edge_length_iso)
         return edge_length_iso(p0, p1, met[i0], met[i1])
     if use_pallas():
-        return edge_length_ani_pallas(p0, p1, met[i0], met[i1])
+        return jax.lax.platform_dependent(
+            p0, p1, met[i0], met[i1],
+            tpu=partial(edge_length_ani_pallas, interpret=False),
+            default=edge_length_ani)
     return edge_length_ani(p0, p1, met[i0], met[i1])
 
 

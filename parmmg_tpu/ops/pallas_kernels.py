@@ -41,16 +41,19 @@ _BLOCK = _SUB * _LANE
 
 
 def use_pallas() -> bool:
-    """Production gate: real TPU backend, unless overridden."""
+    """Are the Pallas kernels ALLOWED (pallas importable, not disabled)?
+
+    The actual TPU-vs-other choice is made at LOWERING time by
+    ``jax.lax.platform_dependent`` at the call sites — deciding from
+    ``jax.default_backend()`` here was wrong whenever a TPU plugin is
+    registered as the process default while a computation lowers for CPU
+    devices (e.g. the multichip dry run on the virtual CPU mesh), which
+    crashed with 'Only interpret mode is supported on CPU backend'.
+    """
     env = os.environ.get("PARMMG_TPU_PALLAS", "")
     if env == "0":
         return False
-    if env == "1":
-        return True
-    try:
-        return HAVE_PALLAS and jax.default_backend() == "tpu"
-    except Exception:  # pragma: no cover
-        return False
+    return HAVE_PALLAS
 
 
 def _pad_rows(n: int) -> int:

@@ -123,6 +123,13 @@ def quality_from_points(p: jax.Array, m6: jax.Array | None = None):
     return jnp.where(vol > 0, jnp.minimum(q, 1.0), jnp.minimum(q, 0.0))
 
 
+def _quality_m6bar(p: jax.Array, m6bar: jax.Array) -> jax.Array:
+    """jnp fallback for the aniso Pallas quality kernel: the tet-average
+    metric is already formed, so reuse quality_from_points with a
+    singleton corner axis (its internal mean is then the identity)."""
+    return quality_from_points(p, m6bar[..., None, :])
+
+
 def tet_quality(mesh: Mesh, met: jax.Array | None = None) -> jax.Array:
     """[capT] quality in [0,1], equilateral=1; <=0 for inverted/degenerate.
 
@@ -130,12 +137,21 @@ def tet_quality(mesh: Mesh, met: jax.Array | None = None) -> jax.Array:
     metric, matching MMG5_caltet_iso); aniso path measures volume and edge
     lengths in the average tet metric (MMG5_caltet_ani semantics).
     """
+    from functools import partial
     from .pallas_kernels import use_pallas, quality_pallas
     if use_pallas():
         p = mesh.vert[mesh.tet]                         # [T,4,3]
-        m6bar = None if (met is None or met.ndim == 1) \
-            else jnp.mean(met[mesh.tet], axis=1)
-        q = quality_pallas(p, m6bar)
+        if met is None or met.ndim == 1:
+            q = jax.lax.platform_dependent(
+                p,
+                tpu=partial(quality_pallas, m6bar=None, interpret=False),
+                default=lambda pp: quality_from_points(pp, None))
+        else:
+            m6bar = jnp.mean(met[mesh.tet], axis=1)
+            q = jax.lax.platform_dependent(
+                p, m6bar,
+                tpu=partial(quality_pallas, interpret=False),
+                default=_quality_m6bar)
         return jnp.where(mesh.tmask, q, 0.0)
     vol = tet_volumes(mesh)
     ev = tet_edge_vertices(mesh.tet)

@@ -70,8 +70,14 @@ def test_native_scan_speed_sanity(tmp_path):
     m.tetra, m.tref = tet, np.zeros(len(tet), np.int32)
     p = tmp_path / "big.mesh"
     medit.write_mesh(p, m)
-    t0 = time.perf_counter(); medit.read_mesh(p); t_py = \
-        time.perf_counter() - t0
-    t0 = time.perf_counter(); native.scan_medit(p); t_c = \
-        time.perf_counter() - t0
+    # best-of-3 each: a single timing under concurrent CI load is noise
+    t_py = min(_timed(lambda: medit.read_mesh(p)) for _ in range(3))
+    t_c = min(_timed(lambda: native.scan_medit(p)) for _ in range(3))
     assert t_c < t_py * 1.5
+
+
+def _timed(fn):
+    import time
+    t0 = time.perf_counter()
+    fn()
+    return time.perf_counter() - t0
