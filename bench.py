@@ -89,19 +89,27 @@ def main() -> None:
 
     # block schedule: global cycle indices keep the swap cadence identical
     # to the unfused host driver (swap every 3rd global cycle)
+    warm_cycles = 2 * block
     sched = []
     b = 0
     while b < cycles:
         nc = min(block, cycles - b)
-        sched.append((b, nc, (block + b) % 3))
+        sched.append((b, nc, (warm_cycles + b) % 3))
         b += nc
 
-    # warm-up: run one block (real work), then warm every other distinct
-    # flavor by EXECUTING it on a copy of the state — AOT
-    # .lower().compile() would not populate the jit dispatch cache, so
-    # tracing+compile would still land inside the timed loop
+    # warm-up: TWO blocks.  The first compiles for the host-staged input
+    # layout; its outputs are device arrays with a different layout, so
+    # the very next call triggers a SECOND compile — running it here (not
+    # in the timed loop) is what kills the consistent ~170s first-block
+    # artifact.  Then warm every other distinct flavor by EXECUTING it on
+    # a copy of the state (AOT .lower().compile() would not populate the
+    # jit dispatch cache).
     m1, k1, wcnt = adapt_cycles_fused(mesh, met, jnp.asarray(0, jnp.int32),
                                       n_cycles=block, swap_every=3)
+    jax.block_until_ready(wcnt)
+    m1, k1, wcnt = adapt_cycles_fused(m1, k1, jnp.asarray(block, jnp.int32),
+                                      n_cycles=block, swap_every=3,
+                                      swap_offset=block % 3)
     jax.block_until_ready(wcnt)
     for nc, off in sorted({(nc, off) for _, nc, off in sched}
                           - {(block, 0)}):
@@ -123,7 +131,7 @@ def main() -> None:
     for b, nc, off in sched:
         t0 = time.perf_counter()
         m, k, counts = adapt_cycles_fused(
-            m, k, jnp.asarray(block + b, jnp.int32), n_cycles=nc,
+            m, k, jnp.asarray(warm_cycles + b, jnp.int32), n_cycles=nc,
             swap_every=3, swap_offset=off)
         cs = np.asarray(counts)                   # blocks on this block
         times.append(time.perf_counter() - t0)
@@ -167,9 +175,44 @@ def main() -> None:
         "extra": {"ntets_final": int(tm.sum()), "qmin": round(qmin, 4),
                   "qmean": round(qmean, 4), "cycles": cycles,
                   "sum_rate": round(mtets_sum, 4),
-                  "device": str(jax.devices()[0].platform)},
+                  "device": str(jax.devices()[0].platform),
+                  "fallback": os.environ.get(
+                      "PARMMG_BENCH_FALLBACK", "") == "1"},
     }))
 
 
+def _is_transport_error(e: Exception) -> bool:
+    """Tunnel/device transport failures only — a deterministic code bug
+    must surface, not be retried or silently re-run on CPU."""
+    try:
+        from jax.errors import JaxRuntimeError
+    except Exception:  # pragma: no cover
+        return False
+    return isinstance(e, JaxRuntimeError)
+
+
 if __name__ == "__main__":
-    main()
+    try:
+        main()
+    except Exception as e:
+        # the tunnel's remote_compile endpoint intermittently drops the
+        # response mid-read; one in-process retry usually succeeds.  If
+        # the device stays broken, re-exec on CPU so the benchmark still
+        # reports a number (tagged device=cpu, fallback=true) instead of
+        # crashing the round.
+        if os.environ.get("JAX_PLATFORMS", "") == "cpu" \
+                or not _is_transport_error(e):
+            raise
+        print(f"bench: device attempt failed ({type(e).__name__}: {e}); "
+              "retrying once", file=sys.stderr)
+        try:
+            main()
+        except Exception as e2:
+            if not _is_transport_error(e2):
+                raise
+            print(f"bench: retry failed ({type(e2).__name__}); "
+                  "re-executing on CPU backend", file=sys.stderr)
+            env = dict(os.environ, JAX_PLATFORMS="cpu",
+                       PARMMG_BENCH_FALLBACK="1")
+            os.execvpe(sys.executable,
+                       [sys.executable, os.path.abspath(__file__)], env)

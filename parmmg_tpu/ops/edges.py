@@ -155,25 +155,23 @@ def edge_lengths(mesh: Mesh, et: EdgeTable, met: jax.Array) -> jax.Array:
     lowers for CPU devices)."""
     from functools import partial
     from .quality import edge_length_iso, edge_length_ani
-    from .pallas_kernels import (use_pallas, edge_length_iso_pallas,
+    from .pallas_kernels import (use_pallas, pallas_forced,
+                                 edge_length_iso_pallas,
                                  edge_length_ani_pallas)
     p0 = mesh.vert[jnp.clip(et.ev[:, 0], 0, mesh.capP - 1)]
     p1 = mesh.vert[jnp.clip(et.ev[:, 1], 0, mesh.capP - 1)]
     i0 = jnp.clip(et.ev[:, 0], 0, mesh.capP - 1)
     i1 = jnp.clip(et.ev[:, 1], 0, mesh.capP - 1)
-    if met.ndim == 1:
-        if use_pallas():
-            return jax.lax.platform_dependent(
-                p0, p1, met[i0], met[i1],
-                tpu=partial(edge_length_iso_pallas, interpret=False),
-                default=edge_length_iso)
-        return edge_length_iso(p0, p1, met[i0], met[i1])
+    pal = (edge_length_iso_pallas if met.ndim == 1
+           else edge_length_ani_pallas)
+    ref = edge_length_iso if met.ndim == 1 else edge_length_ani
+    if pallas_forced():          # PARMMG_TPU_PALLAS=1: interpret off-TPU
+        return pal(p0, p1, met[i0], met[i1])
     if use_pallas():
         return jax.lax.platform_dependent(
             p0, p1, met[i0], met[i1],
-            tpu=partial(edge_length_ani_pallas, interpret=False),
-            default=edge_length_ani)
-    return edge_length_ani(p0, p1, met[i0], met[i1])
+            tpu=partial(pal, interpret=False), default=ref)
+    return ref(p0, p1, met[i0], met[i1])
 
 
 def unique_priority(score: jax.Array, mask: jax.Array) -> jax.Array:

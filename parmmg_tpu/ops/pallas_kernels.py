@@ -56,6 +56,13 @@ def use_pallas() -> bool:
     return HAVE_PALLAS
 
 
+def pallas_forced() -> bool:
+    """PARMMG_TPU_PALLAS=1: call the Pallas kernels UNCONDITIONALLY
+    (interpret mode off-TPU) — lets CPU verification runs exercise the
+    production kernel numerics instead of the jnp formulas."""
+    return HAVE_PALLAS and os.environ.get("PARMMG_TPU_PALLAS", "") == "1"
+
+
 def _pad_rows(n: int) -> int:
     """Rows of a [R,128] view holding n elements, R a multiple of 8."""
     r = -(-n // _LANE)

@@ -138,20 +138,27 @@ def tet_quality(mesh: Mesh, met: jax.Array | None = None) -> jax.Array:
     lengths in the average tet metric (MMG5_caltet_ani semantics).
     """
     from functools import partial
-    from .pallas_kernels import use_pallas, quality_pallas
+    from .pallas_kernels import use_pallas, pallas_forced, quality_pallas
     if use_pallas():
         p = mesh.vert[mesh.tet]                         # [T,4,3]
         if met is None or met.ndim == 1:
-            q = jax.lax.platform_dependent(
-                p,
-                tpu=partial(quality_pallas, m6bar=None, interpret=False),
-                default=lambda pp: quality_from_points(pp, None))
+            if pallas_forced():     # interpret mode off-TPU
+                q = quality_pallas(p, None)
+            else:
+                q = jax.lax.platform_dependent(
+                    p,
+                    tpu=partial(quality_pallas, m6bar=None,
+                                interpret=False),
+                    default=lambda pp: quality_from_points(pp, None))
         else:
             m6bar = jnp.mean(met[mesh.tet], axis=1)
-            q = jax.lax.platform_dependent(
-                p, m6bar,
-                tpu=partial(quality_pallas, interpret=False),
-                default=_quality_m6bar)
+            if pallas_forced():
+                q = quality_pallas(p, m6bar)
+            else:
+                q = jax.lax.platform_dependent(
+                    p, m6bar,
+                    tpu=partial(quality_pallas, interpret=False),
+                    default=_quality_m6bar)
         return jnp.where(mesh.tmask, q, 0.0)
     vol = tet_volumes(mesh)
     ev = tet_edge_vertices(mesh.tet)

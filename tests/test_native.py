@@ -62,7 +62,6 @@ def test_native_components():
 def test_native_scan_speed_sanity(tmp_path):
     """The native scanner must beat the Python tokenizer (it is the
     data-loader replacement); generous 1.5x bound to stay robust on CI."""
-    import time
     from parmmg_tpu.io import medit
     vert, tet = cube_mesh(10)
     m = medit.MeditMesh()
