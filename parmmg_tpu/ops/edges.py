@@ -165,12 +165,15 @@ def edge_lengths(mesh: Mesh, et: EdgeTable, met: jax.Array) -> jax.Array:
     pal = (edge_length_iso_pallas if met.ndim == 1
            else edge_length_ani_pallas)
     ref = edge_length_iso if met.ndim == 1 else edge_length_ani
-    if pallas_forced():          # PARMMG_TPU_PALLAS=1: interpret off-TPU
-        return pal(p0, p1, met[i0], met[i1])
     if use_pallas():
+        # the off-TPU branch is chosen at LOWERING time (the process
+        # default may be a TPU plugin while this computation lowers for
+        # CPU devices): jnp formula normally, interpreted Pallas kernel
+        # when PARMMG_TPU_PALLAS=1 forces kernel numerics everywhere
+        off_tpu = partial(pal, interpret=True) if pallas_forced() else ref
         return jax.lax.platform_dependent(
             p0, p1, met[i0], met[i1],
-            tpu=partial(pal, interpret=False), default=ref)
+            tpu=partial(pal, interpret=False), default=off_tpu)
     return ref(p0, p1, met[i0], met[i1])
 
 

@@ -141,24 +141,25 @@ def tet_quality(mesh: Mesh, met: jax.Array | None = None) -> jax.Array:
     from .pallas_kernels import use_pallas, pallas_forced, quality_pallas
     if use_pallas():
         p = mesh.vert[mesh.tet]                         # [T,4,3]
+        # off-TPU branch chosen at lowering time: jnp formula normally,
+        # interpreted Pallas kernel when PARMMG_TPU_PALLAS=1 forces the
+        # production kernel numerics everywhere
         if met is None or met.ndim == 1:
-            if pallas_forced():     # interpret mode off-TPU
-                q = quality_pallas(p, None)
-            else:
-                q = jax.lax.platform_dependent(
-                    p,
-                    tpu=partial(quality_pallas, m6bar=None,
-                                interpret=False),
-                    default=lambda pp: quality_from_points(pp, None))
+            off_tpu = (partial(quality_pallas, m6bar=None, interpret=True)
+                       if pallas_forced()
+                       else lambda pp: quality_from_points(pp, None))
+            q = jax.lax.platform_dependent(
+                p,
+                tpu=partial(quality_pallas, m6bar=None, interpret=False),
+                default=off_tpu)
         else:
             m6bar = jnp.mean(met[mesh.tet], axis=1)
-            if pallas_forced():
-                q = quality_pallas(p, m6bar)
-            else:
-                q = jax.lax.platform_dependent(
-                    p, m6bar,
-                    tpu=partial(quality_pallas, interpret=False),
-                    default=_quality_m6bar)
+            off_tpu = (partial(quality_pallas, interpret=True)
+                       if pallas_forced() else _quality_m6bar)
+            q = jax.lax.platform_dependent(
+                p, m6bar,
+                tpu=partial(quality_pallas, interpret=False),
+                default=off_tpu)
         return jnp.where(mesh.tmask, q, 0.0)
     vol = tet_volumes(mesh)
     ev = tet_edge_vertices(mesh.tet)
