@@ -29,7 +29,7 @@ from ..core.constants import LLONG, LSHRT
 from .adjacency import build_adjacency
 from .split import split_wave
 from .collapse import collapse_wave
-from .swap import swap32_wave, swap23_wave
+from .swap import swap_edges_wave, swap23_wave
 from .smooth import smooth_wave
 
 
@@ -54,11 +54,21 @@ class AdaptStats:
 
 def adapt_cycle_impl(mesh: Mesh, met: jax.Array, wave: jax.Array,
                      do_swap: bool = True, do_smooth: bool = True,
-                     smooth_waves: int = 1, do_insert: bool = True):
+                     smooth_waves: int = 1, do_insert: bool = True,
+                     final_rebuild: bool = True):
     """One adaptation cycle: split -> collapse -> [swap] -> [smooth].
 
     Pure jittable function (jitted wrapper below) — also the compile-check
     entry point exposed by ``__graft_entry__.entry``.
+
+    Adjacency is rebuilt only where a consumer needs it (it is the most
+    expensive primitive of the cycle, ~42 ms at bench shapes): swap23
+    (face pairing) is the ONLY adja reader — split/collapse/edge-swaps/
+    smooth run off the edge table or tets alone (collapse transfers dying
+    tets' face tags with a keyed face join instead of the old adja
+    lookup).  ``final_rebuild`` restores the every-returned-mesh-has-
+    valid-adja contract for external callers; fused blocks skip it
+    between cycles.
 
     Returns (mesh, met, counts) with ``counts`` = int32
     [nsplit, ncollapse, nswap, nmoved, overflow, live_tets] stacked in
@@ -67,38 +77,33 @@ def adapt_cycle_impl(mesh: Mesh, met: jax.Array, wave: jax.Array,
     remote-device transport, and an *eager* count op on the host would
     fight the donated input buffers).
     """
+    from .adjacency import boundary_edge_tags
     if do_insert:
         res = split_wave(mesh, met)
         mesh, met = res.mesh, res.met
-        mesh = build_adjacency(mesh)
         nsplit, overflow = res.nsplit, res.overflow
 
         col = collapse_wave(mesh, met)
-        mesh = col.mesh
-        mesh = build_adjacency(mesh)
         # collapse rewires the surface (dying tets' face tags transfer to
         # the surviving neighbors); re-propagate MG_BDY from faces to
         # their edges and vertices so later splits/smooth treat the new
         # surface entities as boundary — without this, untagged surface
         # midpoints become "movable" and smoothing dents the surface
-        from .adjacency import boundary_edge_tags
-        mesh = boundary_edge_tags(mesh)
+        mesh = boundary_edge_tags(col.mesh)
         ncol = col.ncollapse
     else:
-        # -noinsert: no point insertion or deletion (Mmg contract); keep
-        # the adjacency fresh for the swap/smooth waves
-        mesh = build_adjacency(mesh)
+        # -noinsert: no point insertion or deletion (Mmg contract)
         nsplit = jnp.zeros((), jnp.int32)
         ncol = jnp.zeros((), jnp.int32)
         overflow = jnp.zeros((), bool)
 
     nswap = jnp.zeros((), jnp.int32)
     if do_swap:
-        s32 = swap32_wave(mesh, met)
-        mesh = build_adjacency(s32.mesh)
+        sew = swap_edges_wave(mesh, met)        # 3-2 + 2-2, one edge table
+        mesh = build_adjacency(sew.mesh)        # consumed by swap23
         s23 = swap23_wave(mesh, met)
-        mesh = build_adjacency(s23.mesh)
-        nswap = s32.nswap + s23.nswap
+        mesh = s23.mesh
+        nswap = sew.nswap + s23.nswap
 
     nmoved = jnp.zeros((), jnp.int32)
     if do_smooth:
@@ -107,6 +112,9 @@ def adapt_cycle_impl(mesh: Mesh, met: jax.Array, wave: jax.Array,
             mesh = sm.mesh
             nmoved = nmoved + sm.nmoved
 
+    if final_rebuild:
+        mesh = build_adjacency(mesh)
+
     counts = jnp.stack([nsplit, ncol, nswap, nmoved,
                         overflow.astype(jnp.int32),
                         jnp.sum(mesh.tmask, dtype=jnp.int32)])
@@ -114,7 +122,7 @@ def adapt_cycle_impl(mesh: Mesh, met: jax.Array, wave: jax.Array,
 
 
 adapt_cycle = partial(jax.jit, static_argnames=(
-    "do_swap", "do_smooth", "smooth_waves", "do_insert"),
+    "do_swap", "do_smooth", "smooth_waves", "do_insert", "final_rebuild"),
     donate_argnums=(0, 1))(adapt_cycle_impl)
 
 
@@ -142,7 +150,8 @@ def adapt_cycles_fused_impl(mesh: Mesh, met: jax.Array, wave0: jax.Array,
         # the swap rhythm matches the unfused host driver exactly
         do_swap = ((c + swap_offset) % swap_every == swap_every - 1)
         mesh, met, counts = adapt_cycle_impl(
-            mesh, met, wave0 + c, do_swap=do_swap)
+            mesh, met, wave0 + c, do_swap=do_swap,
+            final_rebuild=(c == n_cycles - 1))
         counts_all.append(counts)
     return mesh, met, jnp.stack(counts_all)
 
@@ -153,7 +162,7 @@ adapt_cycles_fused = partial(jax.jit, static_argnames=(
 
 
 def sliver_polish_impl(mesh: Mesh, met: jax.Array, wave: jax.Array,
-                       sliver_q: float = 0.05, do_collapse: bool = True,
+                       sliver_q: float = 0.2, do_collapse: bool = True,
                        do_swap: bool = True, do_smooth: bool = True):
     """Bad-element optimization pass (MMG3D_opttyp analogue): quality-
     targeted collapses on tets below ``sliver_q``, then swaps and a
@@ -169,19 +178,19 @@ def sliver_polish_impl(mesh: Mesh, met: jax.Array, wave: jax.Array,
     nmoved = jnp.zeros((), jnp.int32)
     if do_collapse:
         col = collapse_wave(mesh, met, sliver_q=sliver_q)
-        mesh = build_adjacency(col.mesh)
-        mesh = boundary_edge_tags(mesh)
+        mesh = boundary_edge_tags(col.mesh)
         ncol = col.ncollapse
     if do_swap:
-        s32 = swap32_wave(mesh, met)
-        mesh = build_adjacency(s32.mesh)
+        sew = swap_edges_wave(mesh, met)        # 3-2 + 2-2, one edge table
+        mesh = build_adjacency(sew.mesh)        # consumed by swap23
         s23 = swap23_wave(mesh, met)
-        mesh = build_adjacency(s23.mesh)
-        nswap = s32.nswap + s23.nswap
+        mesh = s23.mesh
+        nswap = sew.nswap + s23.nswap
     if do_smooth:
         sm = smooth_wave(mesh, met, wave=wave)
         mesh = sm.mesh
         nmoved = sm.nmoved
+    mesh = build_adjacency(mesh)                # exit contract
     counts = jnp.stack([ncol, nswap, nmoved,
                         jnp.sum(mesh.tmask, dtype=jnp.int32)])
     return mesh, counts

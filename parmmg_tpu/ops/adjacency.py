@@ -69,7 +69,11 @@ def build_adjacency(mesh: Mesh) -> Mesh:
     adj_val = jnp.where(matched, 4 * t[partner] + f[partner], -1)
 
     adja = jnp.full((capT, 4), -1, jnp.int32)
-    adja = adja.at[t, f].set(adj_val.astype(jnp.int32))
+    # (t, f) is a permutation of all slots: unique_indices lets the TPU
+    # scatter run fully parallel (duplicate-tolerant scatter measured ~2x
+    # slower at these shapes, scripts/tpu_microbench.py)
+    adja = adja.at[t, f].set(adj_val.astype(jnp.int32),
+                             unique_indices=True)
     adja = jnp.where(mesh.tmask[:, None], adja, -1)
 
     # boundary faces: valid tet, face has no twin
@@ -119,14 +123,17 @@ def boundary_edge_tags(mesh: Mesh) -> Mesh:
             e = int(FACE_EDGES[f, j])
             edge_hit = edge_hit.at[:, e].set(edge_hit[:, e] | is_bdy_face[:, f])
     etag = jnp.where(edge_hit, etag | MG_BDY, etag)
-    # vertices of boundary faces get MG_BDY
+    # vertices of boundary faces get MG_BDY — ONE concatenated scatter
+    # over all 4 faces (per-op overhead dominates scatter cost on this
+    # device; 4 narrow scatters cost ~4x one long one)
     from ..core.constants import IDIR
     vtag = mesh.vtag
-    hit = jnp.zeros(mesh.capP, bool)
-    for f in range(4):
-        vids = mesh.tet[:, jnp.asarray(IDIR[f])]     # [T,3]
-        m = is_bdy_face[:, f] & mesh.tmask
-        hit = hit.at[vids.reshape(-1)].max(
-            jnp.repeat(m, 3))
-    vtag = jnp.where(hit, vtag | MG_BDY, vtag)
+    capP = mesh.capP
+    vids_all = jnp.concatenate(
+        [mesh.tet[:, jnp.asarray(IDIR[f])].reshape(-1) for f in range(4)])
+    m_all = jnp.concatenate(
+        [jnp.repeat(is_bdy_face[:, f] & mesh.tmask, 3) for f in range(4)])
+    hit = jnp.zeros(capP + 1, bool).at[
+        jnp.where(m_all, vids_all, capP)].max(m_all, mode="drop")
+    vtag = jnp.where(hit[:capP], vtag | MG_BDY, vtag)
     return dataclasses_replace(mesh, etag=etag, vtag=vtag)

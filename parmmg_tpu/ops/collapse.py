@@ -132,8 +132,12 @@ def collapse_wave(mesh: Mesh, met: jax.Array, lmin: float = LSHRT,
             hit = hit | ((tv[:, j] == kept[:, k]) & (j != k))
         contains_kept = contains_kept.at[:, k].set(hit)
 
-    geombad = jnp.zeros(capP + 1, bool)
-    newlong = jnp.zeros(capP + 1, bool)
+    # elementwise validity math stays per-corner (XLA fuses it); only the
+    # SCATTERS are concatenated into one long op — per-op overhead
+    # dominates scatter cost on this device (scripts/tpu_microbench.py)
+    idx_act = []
+    bad_all = []
+    act_all = []
     for k in range(4):
         active = has_c[:, k] & mesh.tmask & ~contains_kept[:, k]
         p = vpos.at[:, k].set(kept_pos[:, k])              # moved corner
@@ -163,12 +167,13 @@ def collapse_wave(mesh: Mesh, met: jax.Array, lmin: float = LSHRT,
                 lnew = edge_length_iso(
                     kept_pos[:, k], p[:, j],
                     met[kept[:, k]], met[tv[:, j]])
-                bad_l = lnew > lmax
-                newlong = newlong.at[jnp.where(active, tv[:, k], capP)].max(
-                    bad_l, mode="drop")
-        geombad = geombad.at[jnp.where(active, tv[:, k], capP)].max(
-            bad, mode="drop")
-    geombad = geombad[:capP] | newlong[:capP]
+                bad = bad | (lnew > lmax)
+        idx_act.append(jnp.where(active, tv[:, k], capP))
+        bad_all.append(bad)
+        act_all.append(active)
+    idx_act = jnp.concatenate(idx_act)                     # [4T]
+    geombad = jnp.zeros(capP + 1, bool).at[idx_act].max(
+        jnp.concatenate(bad_all), mode="drop")[:capP]
 
     # --- ball-quality gate ----------------------------------------------
     # Simulate the surviving ball of each removal target and compare min
@@ -182,21 +187,19 @@ def collapse_wave(mesh: Mesh, met: jax.Array, lmin: float = LSHRT,
     mq = None if met.ndim == 1 else met[tv]
     if sliver_q is None:
         q_tet = quality_from_points(vpos, mq)
-    ballq_old = jnp.full(capP + 1, jnp.inf)
-    for k in range(4):
-        idx = jnp.where(mesh.tmask, tv[:, k], capP)
-        ballq_old = ballq_old.at[idx].min(
-            jnp.where(mesh.tmask, q_tet, jnp.inf), mode="drop")
-    ballq_new = jnp.full(capP + 1, jnp.inf)
-    for k in range(4):
-        active = has_c[:, k] & mesh.tmask & ~contains_kept[:, k]
-        p = vpos.at[:, k].set(kept_pos[:, k])
-        mqk = None if mq is None else \
-            mq.at[:, k].set(met[kept[:, k]])
-        qk = quality_from_points(p, mqk)
-        ballq_new = ballq_new.at[
-            jnp.where(active, tv[:, k], capP)].min(
-            jnp.where(active, qk, jnp.inf), mode="drop")
+    idx4c = jnp.concatenate(
+        [jnp.where(mesh.tmask, tv[:, k], capP) for k in range(4)])
+    ballq_old = jnp.full(capP + 1, jnp.inf).at[idx4c].min(
+        jnp.tile(jnp.where(mesh.tmask, q_tet, jnp.inf), 4), mode="drop")
+    # the 4 moved-corner variants as ONE stacked quality call + scatter
+    variants = jnp.concatenate(
+        [vpos.at[:, k].set(kept_pos[:, k]) for k in range(4)])
+    mq4 = None if mq is None else jnp.concatenate(
+        [mq.at[:, k].set(met[kept[:, k]]) for k in range(4)])
+    qv = quality_from_points(variants, mq4)                # [4T]
+    act4 = jnp.concatenate(act_all)
+    ballq_new = jnp.full(capP + 1, jnp.inf).at[idx_act].min(
+        jnp.where(act4, qv, jnp.inf), mode="drop")
     if sliver_q is None:
         ok = (ballq_new[:capP] >= 0.3 * ballq_old[:capP]) & \
              (ballq_new[:capP] > QUAL_FLOOR)
@@ -213,23 +216,24 @@ def collapse_wave(mesh: Mesh, met: jax.Array, lmin: float = LSHRT,
     tsel = jnp.where(sel, vt_c, PRI_MIN)
     tmax_t = jnp.max(tsel, axis=1)
     corner_max = sel & (tsel == tmax_t[:, None])
-    contested = jnp.zeros(capP + 1, bool)
-    for k in range(4):
-        mism = has_c[:, k] & ~corner_max[:, k] & mesh.tmask
-        contested = contested.at[
-            jnp.where(mesh.tmask, tv[:, k], capP)].max(mism, mode="drop")
-    contested = contested[:capP]
+    mism4 = jnp.concatenate(
+        [has_c[:, k] & ~corner_max[:, k] & mesh.tmask for k in range(4)])
+    contested = jnp.zeros(capP + 1, bool).at[idx4c].max(
+        mism4, mode="drop")[:capP]
 
     # vertex claims: a winner must be the (s,t)-max among all candidate
-    # edges touching either of its endpoints (both roles)
-    cl_s = jnp.full(capP + 1, NEG_INF)
-    cl_s = cl_s.at[jnp.where(cand, rm, capP)].max(s, mode="drop")
-    cl_s = cl_s.at[jnp.where(cand, kp, capP)].max(s, mode="drop")
+    # edges touching either of its endpoints (both roles) — one
+    # concatenated scatter per channel
+    idx_rk = jnp.concatenate([jnp.where(cand, rm, capP),
+                              jnp.where(cand, kp, capP)])
+    cl_s = jnp.full(capP + 1, NEG_INF).at[idx_rk].max(
+        jnp.tile(s, 2), mode="drop")
     eq_rm = cand & (s == cl_s[rm])
     eq_kp = cand & (s == cl_s[kp])
-    cl_t = jnp.full(capP + 1, PRI_MIN)
-    cl_t = cl_t.at[jnp.where(eq_rm, rm, capP)].max(t, mode="drop")
-    cl_t = cl_t.at[jnp.where(eq_kp, kp, capP)].max(t, mode="drop")
+    idx_rk2 = jnp.concatenate([jnp.where(eq_rm, rm, capP),
+                               jnp.where(eq_kp, kp, capP)])
+    cl_t = jnp.full(capP + 1, PRI_MIN).at[idx_rk2].max(
+        jnp.tile(t, 2), mode="drop")
     claim_ok = eq_rm & (t == cl_t[rm]) & eq_kp & (t == cl_t[kp])
 
     win = cand & is_top & ~geombad[rm] & ~contested[rm] & claim_ok
@@ -248,19 +252,61 @@ def collapse_wave(mesh: Mesh, met: jax.Array, lmin: float = LSHRT,
     tmask = mesh.tmask & ~dead
     vmask = mesh.vmask.at[jnp.where(win, rm, capP)].set(False, mode="drop")
 
-    # --- transfer face tags from dying tets to surviving neighbors -------
-    # the shared face sits at (nb, nf) on the other side; it survives there
-    nb = mesh.adja >> 2
-    nf = mesh.adja & 3
-    has_nb = mesh.adja >= 0
-    nb_safe = jnp.clip(nb, 0, capT - 1)
-    nb_dead = dead[nb_safe] & has_nb
-    # receiving side: tet alive, neighbor dying, neighbor's face tagged
-    recv = (~dead)[:, None] & nb_dead & mesh.tmask[:, None]
-    nbr_ftag = mesh.ftag[nb_safe, nf]
-    nbr_fref = mesh.fref[nb_safe, nf]
-    ftag = jnp.where(recv, mesh.ftag | nbr_ftag, mesh.ftag)
-    fref = jnp.where(recv & (nbr_fref != 0), nbr_fref, mesh.fref)
+    # --- transfer face tags/refs from dying tets: keyed face join --------
+    # Every face of the REMAPPED mesh is keyed by its sorted vertex
+    # triple; dying tets donate their old tags/refs, alive slots with the
+    # same key OR/max them in.  This covers BOTH transfer cases: the
+    # shared-slot case (dying tet's interior face survives on the
+    # neighbor — the old adja-based transfer) and the remapped-boundary
+    # case (dying tet's tagged surface face (rm,u,w) becomes (kp,u,w),
+    # owned by a tet that never shared a slot with the donor — the old
+    # code recovered only the MG_BDY bit via the next build_adjacency and
+    # silently dropped fref/REQ/REF bits).
+    from ..core.mesh import tet_face_vertices
+    from .edges import PACK_LIMIT, segmented_or, segmented_max
+    F4 = capT * 4
+    fvn = jnp.sort(tet_face_vertices(new_tet).reshape(F4, 3), axis=1)
+    donor_f = jnp.repeat(dead, 4)
+    recv_f = jnp.repeat(tmask, 4)
+    rel_f = donor_f | recv_f
+    i32max = jnp.iinfo(jnp.int32).max
+    if capP <= PACK_LIMIT:
+        w_f = jnp.where(rel_f, fvn[:, 1] * capP + fvn[:, 2], i32max)
+        k0_f = jnp.where(rel_f, fvn[:, 0], i32max)
+        order_f = jnp.lexsort((w_f, k0_f))
+        k0s, k1s = k0_f[order_f], w_f[order_f]
+        first_f = jnp.concatenate(
+            [jnp.array([True]), (k0s[1:] != k0s[:-1]) | (k1s[1:] != k1s[:-1])])
+    else:
+        c0 = jnp.where(rel_f, fvn[:, 0], i32max)
+        c1 = jnp.where(rel_f, fvn[:, 1], i32max)
+        c2 = jnp.where(rel_f, fvn[:, 2], i32max)
+        order_f = jnp.lexsort((c2, c1, c0))
+        k0s, k1s, k2s = c0[order_f], c1[order_f], c2[order_f]
+        first_f = jnp.concatenate(
+            [jnp.array([True]), (k0s[1:] != k0s[:-1]) |
+             (k1s[1:] != k1s[:-1]) | (k2s[1:] != k2s[:-1])])
+    seg_f = jax.lax.associative_scan(
+        jnp.maximum, jnp.where(first_f, jnp.arange(F4), 0))
+    is_last_f = jnp.concatenate([first_f[1:], jnp.array([True])])
+    dtag_f = jnp.where(donor_f[order_f], mesh.ftag.reshape(F4)[order_f], 0)
+    or_f = segmented_or(first_f, dtag_f)
+    tot_tag = jnp.zeros(F4, jnp.uint32).at[
+        jnp.where(is_last_f, seg_f, F4)].set(
+        or_f, mode="drop", unique_indices=True)
+    add_tag_s = tot_tag[seg_f]
+    add_tag = jnp.zeros(F4, jnp.uint32).at[order_f].set(
+        add_tag_s, unique_indices=True).reshape(capT, 4)
+    dref_f = jnp.where(donor_f[order_f], mesh.fref.reshape(F4)[order_f], 0)
+    mx_f = segmented_max(first_f, dref_f)
+    tot_ref = jnp.zeros(F4, jnp.int32).at[
+        jnp.where(is_last_f, seg_f, F4)].set(
+        mx_f, mode="drop", unique_indices=True)
+    add_ref = jnp.zeros(F4, jnp.int32).at[order_f].set(
+        tot_ref[seg_f], unique_indices=True).reshape(capT, 4)
+    ftag = jnp.where(tmask[:, None], mesh.ftag | add_tag, mesh.ftag)
+    fref = jnp.where(tmask[:, None] & (mesh.fref == 0) & (add_ref != 0),
+                     add_ref, mesh.fref)
 
     # --- transfer edge tags from dying tets to surviving slots -----------
     # The collapse merges edge (u,rm) into (u,kp).  Mmg's colver unites

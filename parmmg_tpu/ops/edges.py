@@ -57,13 +57,23 @@ def segmented_or(first: jax.Array, values: jax.Array) -> jax.Array:
 
     ``first`` marks segment heads; returns the running OR within each
     segment (the LAST element of a segment holds the full segment OR).
-    Shared by unique_edges and the collapse edge-tag transfer join.
+    Shared by unique_edges and the collapse edge/face tag-transfer joins.
     """
     def seg_or(pair_a, pair_b):
         fa, va = pair_a
         fb, vb = pair_b
         return fa | fb, jnp.where(fb, vb, va | vb)
     _, out = jax.lax.associative_scan(seg_or, (first, values))
+    return out
+
+
+def segmented_max(first: jax.Array, values: jax.Array) -> jax.Array:
+    """Inclusive segmented max scan (same contract as segmented_or)."""
+    def seg_max(pair_a, pair_b):
+        fa, va = pair_a
+        fb, vb = pair_b
+        return fa | fb, jnp.where(fb, vb, jnp.maximum(va, vb))
+    _, out = jax.lax.associative_scan(seg_max, (first, values))
     return out
 
 
@@ -175,6 +185,30 @@ def edge_lengths(mesh: Mesh, et: EdgeTable, met: jax.Array) -> jax.Array:
             p0, p1, met[i0], met[i1],
             tpu=partial(pal, interpret=False), default=off_tpu)
     return ref(p0, p1, met[i0], met[i1])
+
+
+def claim_shells(score, cand, shells, capT):
+    """Exclusive multi-slot claims: winner must be the two-channel
+    (score, tie-hash) max at EVERY shell slot it touches.  Winners are
+    pairwise shell-disjoint: two winners sharing a slot would both be
+    that slot's pooled (s,t)-max — impossible, t is unique.  Shared by
+    the swap kernels (each candidate claims its 2-3 cavity tets).
+
+    All shells are claimed in ONE concatenated scatter per channel and
+    checked with one stacked gather — per-op overhead dominates
+    scatter/gather cost on this device (scripts/tpu_microbench.py)."""
+    ps, pt = claim_channels(score, cand)
+    k = len(shells)
+    shs = jnp.stack(shells)                               # [k, E]
+    idx = jnp.where(cand[None, :], shs, capT).reshape(-1)
+    cl_s = jnp.full(capT + 1, NEG_INF).at[idx].max(
+        jnp.tile(ps, k), mode="drop")
+    eq = cand & jnp.all(ps[None, :] == cl_s[shs], axis=0)
+    idx2 = jnp.where(eq[None, :], shs, capT).reshape(-1)
+    cl_t = jnp.full(capT + 1, PRI_MIN).at[idx2].max(
+        jnp.tile(pt, k), mode="drop")
+    win = eq & jnp.all(pt[None, :] == cl_t[shs], axis=0)
+    return win
 
 
 def unique_priority(score: jax.Array, mask: jax.Array) -> jax.Array:

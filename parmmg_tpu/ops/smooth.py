@@ -1,15 +1,18 @@
 """Batched vertex smoothing — data-parallel replacement for Mmg's movtet.
 
 Reference behavior: ``MMG5_movtet`` relocates free vertices to improve local
-quality (volume barycenter moves for interior points, tangential moves for
-surface points), never degrading the worst quality of the ball; required /
-corner / parallel-interface points are frozen (the ParMmg contract,
+quality (volume barycenter moves for interior points — ``MMG5_movintpt``;
+tangential moves for regular surface points — ``MMG5_movbdyregpt``), never
+degrading the worst quality of the ball; required / corner / ridge /
+parallel-interface points are frozen (the ParMmg contract,
 tag_pmmg.c:39-124).
 
-Wave scheme: every movable vertex proposes the quality-weighted centroid of
-its ball; validity (ball min-quality must not decrease) is checked
-tet-centrically; a hash-rotated independent set (vertex claims all its ball
-tets) moves per wave so the precheck remains exact under simultaneous moves.
+Wave scheme: every movable vertex proposes a new position (ball-centroid
+for interior points; tangent-plane-projected surface-centroid for regular
+boundary points on locally-flat patches); validity (ball min-quality must
+not decrease) is checked tet-centrically; a hash-rotated independent set
+(vertex claims all its ball tets) moves per wave so the precheck remains
+exact under simultaneous moves.
 """
 from __future__ import annotations
 
@@ -21,9 +24,16 @@ import jax.numpy as jnp
 
 from ..core.mesh import Mesh
 from ..core.constants import (
-    MG_BDY, MG_CRN, MG_GEO, MG_REQ, MG_PARBDY, QUAL_FLOOR)
+    IDIR, MG_BDY, MG_CRN, MG_GEO, MG_NOM, MG_REF, MG_REQ, MG_PARBDY,
+    EPSD, QUAL_FLOOR)
 from .quality import quality_from_points
 from .edges import PRI_MIN
+
+# a regular surface point only slides in its tangent plane when every
+# incident boundary face lies within ~2.6 deg of the average normal — the
+# move is then surface-exact; curved patches wait for hausd-driven
+# reprojection (Mmg reprojects onto the surface ball instead)
+FLAT_COS = 0.999
 
 
 class SmoothResult(NamedTuple):
@@ -34,21 +44,69 @@ class SmoothResult(NamedTuple):
 def smooth_wave(mesh: Mesh, met: jax.Array, wave: int = 0,
                 relax: float = 1.0) -> SmoothResult:
     capT, capP = mesh.capT, mesh.capP
-    movable = mesh.vmask & ((mesh.vtag &
-                             (MG_BDY | MG_REQ | MG_CRN | MG_PARBDY)) == 0)
+    movable_int = mesh.vmask & ((mesh.vtag &
+                                 (MG_BDY | MG_REQ | MG_CRN | MG_PARBDY))
+                                == 0)
+    reg_bdy = mesh.vmask & ((mesh.vtag & MG_BDY) != 0) & \
+        ((mesh.vtag & (MG_REQ | MG_CRN | MG_PARBDY | MG_GEO | MG_NOM |
+                       MG_REF)) == 0)
 
     tv = mesh.tet
     vpos = mesh.vert[tv]                                   # [T,4,3]
     centroid = jnp.mean(vpos, axis=1)                      # [T,3]
     # proposal: mean of ball-tet centroids (volume-barycenter flavor of
-    # MMG5_movintpt)
-    acc = jnp.zeros((capP + 1, 3), mesh.vert.dtype)
-    cnt = jnp.zeros((capP + 1,), mesh.vert.dtype)
-    for k in range(4):
-        idx = jnp.where(mesh.tmask, tv[:, k], capP)
-        acc = acc.at[idx].add(centroid, mode="drop")
-        cnt = cnt.at[idx].add(1.0, mode="drop")
-    prop = acc[:capP] / jnp.maximum(cnt[:capP, None], 1.0)
+    # MMG5_movintpt).  All 4 corners accumulate in ONE concatenated wide
+    # scatter — per-op overhead dominates scatter cost on this device
+    # (scripts/tpu_microbench.py: cost is flat in payload width).
+    idx4 = jnp.concatenate(
+        [jnp.where(mesh.tmask, tv[:, k], capP) for k in range(4)])
+    pay = jnp.concatenate([jnp.concatenate(
+        [centroid, jnp.ones((centroid.shape[0], 1), mesh.vert.dtype)],
+        axis=1)] * 4)                                      # [4T, 4]
+    acc4 = jnp.zeros((capP + 1, 4), mesh.vert.dtype).at[idx4].add(
+        pay, mode="drop")
+    prop = acc4[:capP, :3] / jnp.maximum(acc4[:capP, 3:], 1.0)
+
+    # --- surface proposals (movbdyregpt): tangential move on flat patch --
+    idir = jnp.asarray(IDIR)
+    isb = ((mesh.ftag & MG_BDY) != 0) & mesh.tmask[:, None]   # [T,4]
+    fv = tv[:, idir]                                       # [T,4,3] vids
+    fp = mesh.vert[fv]                                     # [T,4,3,3]
+    fn = jnp.cross(fp[:, :, 1] - fp[:, :, 0],
+                   fp[:, :, 2] - fp[:, :, 0])              # [T,4,3] outward
+    fc = jnp.mean(fp, axis=2)                              # [T,4,3]
+    farea = 0.5 * jnp.sqrt(jnp.sum(fn * fn, -1))           # [T,4]
+    # all 12 (face, corner) contributions in ONE wide scatter:
+    # payload = (area-weighted normal[3], area*centroid[3], area[1])
+    idx12 = jnp.concatenate(
+        [jnp.where(isb[:, f], fv[:, f, k], capP)
+         for f in range(4) for k in range(3)])
+    w4 = jnp.where(isb, farea, 0.0)                        # [T,4]
+    pay_f = jnp.concatenate(
+        [fn, w4[..., None] * fc, w4[..., None]], axis=-1)  # [T,4,7]
+    pay12 = jnp.concatenate(
+        [pay_f[:, f] for f in range(4) for _ in range(3)])
+    sacc = jnp.zeros((capP + 1, 7), mesh.vert.dtype).at[idx12].add(
+        pay12, mode="drop")
+    nacc, cacc, aacc = sacc[:, :3], sacc[:, 3:6], sacc[:, 6]
+    navg = nacc[:capP] / (jnp.linalg.norm(nacc[:capP], axis=-1,
+                                          keepdims=True) + EPSD)
+    # locally-flat gate: every incident boundary face within FLAT_COS of
+    # the average normal (second pass against the computed navg; again
+    # one concatenated scatter-min)
+    fn_unit = fn / (jnp.linalg.norm(fn, axis=-1, keepdims=True) + EPSD)
+    dot12 = jnp.concatenate(
+        [jnp.sum(fn_unit[:, f] * navg[jnp.clip(fv[:, f, k], 0, capP - 1)],
+                 -1) for f in range(4) for k in range(3)])
+    ndev = jnp.full((capP + 1,), jnp.inf, mesh.vert.dtype).at[idx12].min(
+        dot12, mode="drop")
+    flat = (ndev[:capP] >= FLAT_COS) & (aacc[:capP] > 0)
+    bdy_ok = reg_bdy & flat
+    cbar = cacc[:capP] / jnp.maximum(aacc[:capP, None], EPSD)
+    dvec = cbar - mesh.vert
+    dvec = dvec - jnp.sum(dvec * navg, -1, keepdims=True) * navg
+    prop = jnp.where(bdy_ok[:, None], mesh.vert + dvec, prop)
+    movable = movable_int | bdy_ok
 
     # --- validity: per-ball min quality must not decrease ----------------
     # Try a cascade of relaxation factors (Mmg's movtet retries with damped
@@ -60,25 +118,25 @@ def smooth_wave(mesh: Mesh, met: jax.Array, wave: int = 0,
     # win per wave.
     mq = None if met.ndim == 1 else met[tv]                # [T,4,6] | None
     q_old = quality_from_points(vpos, mq)                  # [T]
-    minq_old = jnp.full(capP + 1, jnp.inf, mesh.vert.dtype)
-    for k in range(4):
-        idx = jnp.where(mesh.tmask, tv[:, k], capP)
-        minq_old = minq_old.at[idx].min(
-            jnp.where(mesh.tmask, q_old, jnp.inf), mode="drop")
+    minq_old = jnp.full(capP + 1, jnp.inf, mesh.vert.dtype).at[idx4].min(
+        jnp.tile(jnp.where(mesh.tmask, q_old, jnp.inf), 4), mode="drop")
     minq_old = minq_old[:capP]
 
+    # the 4 per-corner displacement variants are evaluated as ONE stacked
+    # quality call per relaxation step (4x batch ~ free, 4 calls are not)
+    mq4 = None if mq is None else jnp.tile(mq, (4, 1, 1))
     newpos = mesh.vert
     best_gain = jnp.zeros(capP, mesh.vert.dtype)
     for step in (relax, 0.5 * relax, 0.25 * relax):
         cand_pos = mesh.vert + step * (prop - mesh.vert)
         cand_pos = jnp.where(movable[:, None], cand_pos, mesh.vert)
-        minq_new = jnp.full(capP + 1, jnp.inf, mesh.vert.dtype)
-        for k in range(4):
-            idx = jnp.where(mesh.tmask, tv[:, k], capP)
-            p_k = vpos.at[:, k].set(cand_pos[tv[:, k]])
-            q_new = quality_from_points(p_k, mq)
-            minq_new = minq_new.at[idx].min(
-                jnp.where(mesh.tmask, q_new, jnp.inf), mode="drop")
+        newp = cand_pos[tv]                                # [T,4,3]
+        variants = jnp.concatenate(
+            [vpos.at[:, k].set(newp[:, k]) for k in range(4)])  # [4T,4,3]
+        qv = quality_from_points(variants, mq4)            # [4T]
+        minq_new = jnp.full(capP + 1, jnp.inf, mesh.vert.dtype).at[
+            idx4].min(jnp.where(jnp.tile(mesh.tmask, 4), qv, jnp.inf),
+                      mode="drop")
         gain = minq_new[:capP] - minq_old
         ok = (minq_new[:capP] > jnp.maximum(minq_old, QUAL_FLOOR)) & movable
         take = ok & (gain > best_gain)
@@ -99,11 +157,10 @@ def smooth_wave(mesh: Mesh, met: jax.Array, wave: int = 0,
     vpri = jnp.where(improves, h.astype(jnp.int32), PRI_MIN)
     tclaim = jnp.max(jnp.where(mesh.tmask[:, None], vpri[tv], PRI_MIN),
                      axis=1)
-    lost = jnp.zeros(capP + 1, bool)
-    for k in range(4):
-        idx = jnp.where(mesh.tmask, tv[:, k], capP)
-        mism = improves[tv[:, k]] & (tclaim != vpri[tv[:, k]])
-        lost = lost.at[idx].max(mism, mode="drop")
+    vpri_c = vpri[tv]                                      # [T,4]
+    mism4 = jnp.concatenate(
+        [improves[tv[:, k]] & (tclaim != vpri_c[:, k]) for k in range(4)])
+    lost = jnp.zeros(capP + 1, bool).at[idx4].max(mism4, mode="drop")
     win = improves & ~lost[:capP]
 
     vert = jnp.where(win[:, None], newpos, mesh.vert)

@@ -1,14 +1,12 @@
-"""Batched topology swaps (3-2 edge swap, 2-3 face swap).
+"""Batched topology swaps (3-2 edge swap, 2-3 face swap, 2-2 boundary swap).
 
 Reference behavior: Mmg's ``MMG5_swpmsh``/``MMG3D_swpmshcpy`` remove bad
 configurations by re-triangulating small cavities around an edge or face
-when the worst quality strictly improves; the frozen-interface contract
-(tag_pmmg.c:39-124) keeps parallel entities untouched.
-
-v1 scope: swaps run only on *fully interior, untagged* cavities (no shell
-tet carries face/edge tags), which sidesteps tag re-routing; boundary-aware
-swaps are a later milestone.  Improvement gate: new worst quality >
-SWAP_GAIN * old worst (Mmg uses 1.053).
+when the worst quality strictly improves; boundary edges are swapped by
+``MMG5_swpbdy`` after ``MMG5_chkswpbdy`` validates the surface retiling;
+the frozen-interface contract (tag_pmmg.c:39-124) keeps parallel entities
+untouched.  Improvement gate: new worst quality > SWAP_GAIN * old worst
+(Mmg uses 1.053).
 """
 from __future__ import annotations
 
@@ -17,13 +15,23 @@ from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ..core.mesh import Mesh
-from ..core.constants import EPSD, QUAL_FLOOR
-from .edges import unique_edges, claim_channels, NEG_INF, PRI_MIN
+from ..core.constants import (
+    EPSD, QUAL_FLOOR, MG_BDY, MG_GEO, MG_NOM, MG_OPNBDY, MG_PARBDY,
+    MG_REF, MG_REQ)
+from .edges import (unique_edges, claim_channels, claim_shells, NEG_INF,
+                    PRI_MIN)
 from .quality import quality_from_points
 
 SWAP_GAIN = 1.053
+
+# local edge index for a corner pair (i, j) — inverse of IARE
+_EDGE_OF = np.zeros((4, 4), np.int32)
+for _e, (_i, _j) in enumerate([[0, 1], [0, 2], [0, 3],
+                               [1, 2], [1, 3], [2, 3]]):
+    _EDGE_OF[_i, _j] = _EDGE_OF[_j, _i] = _e
 
 
 class SwapResult(NamedTuple):
@@ -39,115 +47,348 @@ def _met6(met):
     return None if met.ndim == 1 else met
 
 
-def swap32_wave(mesh: Mesh, met: jax.Array) -> SwapResult:
-    """3-to-2 swap: interior edges with exactly 3 shell tets.
+def swap_edges_wave(mesh: Mesh, met: jax.Array, enable32: bool = True,
+                    enable22: bool = True,
+                    flat_tol: float = 1e-5) -> SwapResult:
+    """Combined edge-swap wave: 3-2 interior + 2-2 boundary, ONE pass.
 
-    Shell T1=(a,b,p,q), T2, T3 around edge (a,b) with ring (p,q,r) is
-    replaced by tets (p,q,r,a') and (p,q,r,b') — two slots reused, one
-    freed.
+    Both swaps share the same cavity shape — edge (a,b) is replaced by two
+    tets A=(x0,x1,x2,a), B=(x0,x1,x2,b) overwriting the first two shell
+    slots — so they share one edge table, one batched position lookup, one
+    stacked quality call and one claim resolution (each distinct XLA op
+    carries a multi-ms fixed cost on this device, scripts/tpu_microbench.py).
+
+    3-2 (Mmg ``MMG5_swap``): interior untagged edge with a 3-tet shell
+    ring (p,q,r); (x0,x1,x2)=(p,q,r); the third shell slot dies.  The
+    cavity MAY touch the boundary elsewhere: every exterior face/edge
+    survives in A/B and its tags are routed through.
+
+    2-2 (Mmg ``MMG5_swpbdy``/``chkswpbdy``): regular boundary edge whose
+    2-tet shell covers a planar boundary quad (a,x0,b,x1) with shared
+    interior vertex x2=c; the surface diagonal flips to (x0,x1) —
+    surface-exact within ``flat_tol`` of the local scale (the hausd
+    analogue for piecewise-flat geometry); both gates carry float32 noise
+    floors (cross products of coordinate differences err with
+    eps32*|coords|, which swamps a purely relative tolerance on exactly
+    the thin quads this swap targets).
     """
     capT, capP = mesh.capT, mesh.capP
     et = unique_edges(mesh)
     m6 = _met6(met)
+    E = et.ev.shape[0]
+    ar = jnp.arange(E)
+    eof = jnp.asarray(_EDGE_OF)
+    false_e = jnp.zeros(E, bool)
 
     t0, t1, t2 = et.shell3[:, 0], et.shell3[:, 1], et.shell3[:, 2]
-    s0, s1, s2 = (jnp.clip(t0, 0, capT - 1), jnp.clip(t1, 0, capT - 1),
-                  jnp.clip(t2, 0, capT - 1))
-    cand = et.emask & (et.nshell == 3) & (et.etag == 0) & \
-        (t0 >= 0) & (t1 >= 0) & (t2 >= 0)
-    # untagged cavity only
-    for s in (s0, s1, s2):
-        cand = cand & (jnp.sum(mesh.ftag[s], axis=1) == 0) & \
-            (jnp.sum(mesh.etag[s], axis=1) == 0)
-
+    s0 = jnp.clip(t0, 0, capT - 1)
+    s1 = jnp.clip(t1, 0, capT - 1)
+    s2 = jnp.clip(t2, 0, capT - 1)
     a = jnp.clip(et.ev[:, 0], 0, capP - 1)
     b = jnp.clip(et.ev[:, 1], 0, capP - 1)
+    tv0 = mesh.tet[s0]
+    tv1 = mesh.tet[s1]
+    pair_ok = (t0 >= 0) & (t1 >= 0) & \
+        (mesh.tref[s0] == mesh.tref[s1])
 
-    def opp_pair(ts):
-        """the 2 vertices of tet ts not equal to a or b."""
-        tv = mesh.tet[ts]                               # [E,4]
-        is_ab = (tv == a[:, None]) | (tv == b[:, None])
-        # gather the two non-ab corners (positions via argsort of is_ab)
-        ordr = jnp.argsort(is_ab.astype(jnp.int32), axis=1, stable=True)
-        return tv[jnp.arange(tv.shape[0])[:, None], ordr[:, :2]]
+    if enable32:
+        base32 = et.emask & (et.nshell == 3) & (et.etag == 0) & pair_ok & \
+            (t2 >= 0) & (mesh.tref[s0] == mesh.tref[s2])
+    else:
+        base32 = false_e
+    if enable22:
+        frozen22 = (et.etag & (MG_GEO | MG_REQ | MG_PARBDY | MG_NOM |
+                               MG_REF | MG_OPNBDY)) != 0
+        base22 = et.emask & (et.nshell == 2) & \
+            ((et.etag & MG_BDY) != 0) & ~frozen22 & pair_ok
+    else:
+        base22 = false_e
 
-    pq = opp_pair(s0)                                   # [E,2] = (p,q)
-    rs = opp_pair(s1)
-    # r = vertex of T2 not in {p,q}
-    r = jnp.where((rs[:, 0] != pq[:, 0]) & (rs[:, 0] != pq[:, 1]),
-                  rs[:, 0], rs[:, 1])
-    p, q = pq[:, 0], pq[:, 1]
+    # ---- role derivation -------------------------------------------------
+    # s0's two non-(a,b) corners y1, y2
+    is_ab0 = (tv0 == a[:, None]) | (tv0 == b[:, None])
+    ordr = jnp.argsort(is_ab0.astype(jnp.int32), axis=1, stable=True)
+    y1 = tv0[ar, ordr[:, 0]]
+    y2 = tv0[ar, ordr[:, 1]]
+    # 2-2 roles: c = the one shared with T2, p = the other, q = T2's 4th
+    y1_in1 = jnp.any(tv1 == y1[:, None], axis=1)
+    y2_in1 = jnp.any(tv1 == y2[:, None], axis=1)
+    c22 = jnp.where(y1_in1, y1, y2)
+    p22 = jnp.where(y1_in1, y2, y1)
+    is_abc1 = (tv1 == a[:, None]) | (tv1 == b[:, None]) | \
+        (tv1 == c22[:, None])
+    q22 = tv1[ar, jnp.argmax(~is_abc1, axis=1)]
+    # degenerate shells (edge shared without a shared face) rejected
+    base22 = base22 & (y1_in1 ^ y2_in1) & \
+        (jnp.sum(is_abc1.astype(jnp.int32), axis=1) == 3)
+    # 3-2 roles: ring (p,q) from s0, r from s1; relabel (s1,s2) as
+    # (t_pr, t_qr) by which one contains p
+    p32, q32 = y1, y2
+    is_pq1 = (tv1 == p32[:, None]) | (tv1 == q32[:, None])
+    r32 = tv1[ar, jnp.argmax(~(is_abc1 | is_pq1), axis=1)]
+    s1_has_p = jnp.any(tv1 == p32[:, None], axis=1)
+    t_pr = jnp.where(s1_has_p, s1, s2)
+    t_qr = jnp.where(s1_has_p, s2, s1)
 
+    # unified roles: new tets A=(x0,x1,x2,a), B=(x0,x1,x2,b); tag sources
+    # u1 (holds x0,x2 faces/edges) and u2 (holds x1,x2)
+    x0 = jnp.where(base32, p32, p22)
+    x1 = jnp.where(base32, q32, q22)
+    x2 = jnp.where(base32, r32, c22)
+    u1 = jnp.where(base32, t_pr, s0)
+    u2 = jnp.where(base32, t_qr, s1)
+    tu1 = mesh.tet[u1]
+    tu2 = mesh.tet[u2]
+
+    # ---- batched positions of (a, b, x0, x1, x2) in s0/u1/u2 -------------
+    tgt = jnp.stack([a, b, x0, x1, x2], axis=1)            # [E,5]
+
+    def pos5(tv):
+        eqm = tv[:, None, :] == tgt[:, :, None]            # [E,5,4]
+        return (jnp.argmax(eqm, axis=2).astype(jnp.int32),
+                jnp.any(eqm, axis=2))
+
+    P0, in0 = pos5(tv0)
+    P1, in1 = pos5(tu1)
+    P2, in2 = pos5(tu2)
+    # 3-2 ring sanity: u1 must hold {x0,x2}, u2 {x1,x2}
+    ring_ok = in1[:, 2] & in1[:, 4] & in2[:, 3] & in2[:, 4]
+    base32 = base32 & ring_ok
+    base22 = base22 & ring_ok          # holds by construction; belt+braces
+
+    # ---- gathered tag/ref rows (all routing reads go through these) ------
+    et0, et1r, et2r = mesh.etag[s0], mesh.etag[u1], mesh.etag[u2]
+    ft0, ft1r, ft2r = mesh.ftag[s0], mesh.ftag[u1], mesh.ftag[u2]
+    fr0, fr1r, fr2r = mesh.fref[s0], mesh.fref[u1], mesh.fref[u2]
+
+    def ecol(rows, pi, pj):
+        return jnp.take_along_axis(rows, eof[pi, pj][:, None], axis=1)[:, 0]
+
+    def fcol(rows, pi):
+        return jnp.take_along_axis(rows, pi[:, None], axis=1)[:, 0]
+
+    # ---- 2-2 gates: boundary faces, planarity, area, duplicate edge ------
+    if enable22:
+        ft_bdy1 = fcol(ft0, P0[:, 4])          # T1 face opposite c
+        ft_bdy2 = fcol(ft2r, P2[:, 4])         # T2 face opposite c
+        fr_bdy1 = fcol(fr0, P0[:, 4])
+        fr_bdy2 = fcol(fr2r, P2[:, 4])
+        bad_face_bits = MG_REQ | MG_PARBDY | MG_NOM | MG_OPNBDY
+        base22 = base22 & ((ft_bdy1 & MG_BDY) != 0) & \
+            ((ft_bdy2 & MG_BDY) != 0) & \
+            (((ft_bdy1 | ft_bdy2) & bad_face_bits) == 0) & \
+            (ft_bdy1 == ft_bdy2) & (fr_bdy1 == fr_bdy2) & \
+            (fcol(ft0, P0[:, 2]) == 0) & (fcol(ft2r, P2[:, 3]) == 0)
+        newf = ft_bdy1
+        newfr = fr_bdy1
+        newe22 = jnp.uint32(MG_BDY) | (newf & MG_REF)
+
+        pa_, pb_ = mesh.vert[a], mesh.vert[b]
+        pp_, pq_ = mesh.vert[x0], mesh.vert[x1]
+        pc_ = mesh.vert[x2]
+        n_abp = jnp.cross(pb_ - pa_, pp_ - pa_)
+        n_abq = jnp.cross(pq_ - pa_, pb_ - pa_)
+        nn = jnp.sqrt(jnp.sum(n_abp * n_abp, -1)) + EPSD
+        hloc = jnp.sqrt(jnp.maximum(jnp.maximum(
+            jnp.sum((pb_ - pa_) ** 2, -1), jnp.sum((pp_ - pa_) ** 2, -1)),
+            jnp.sum((pq_ - pa_) ** 2, -1)))
+        eps_c = jnp.finfo(mesh.vert.dtype).eps
+        cmax = jnp.max(jnp.stack([jnp.max(jnp.abs(pt_), -1) for pt_ in
+                                  (pa_, pb_, pc_, pp_, pq_)]), axis=0)
+        off_plane = jnp.abs(jnp.sum(n_abp * (pq_ - pa_), -1)) / nn
+        noise_op = 32.0 * eps_c * cmax * hloc * hloc / nn
+        base22 = base22 & (off_plane <= flat_tol * hloc + noise_op)
+        area = lambda nv: 0.5 * jnp.sqrt(jnp.sum(nv * nv, -1))
+        a_old = area(n_abp) + area(n_abq)
+        a_new = area(jnp.cross(pq_ - pp_, pa_ - pp_)) + \
+            area(jnp.cross(pq_ - pp_, pb_ - pp_))
+        noise_ar = 32.0 * eps_c * cmax * hloc
+        base22 = base22 & (jnp.abs(a_old - a_new) <=
+                           1e-5 * (a_old + EPSD) + noise_ar)
+        # the flipped diagonal must not already exist (duplicate edge =>
+        # non-manifold surface).  Packed int32 binary search when ids fit
+        # (edges.PACK_LIMIT); sort-join fallback otherwise (no x64).
+        from .edges import PACK_LIMIT, sort_pairs, segmented_or
+        kmin = jnp.minimum(x0, x1)
+        kmax = jnp.maximum(x0, x1)
+        if capP <= PACK_LIMIT:
+            i32max = jnp.iinfo(jnp.int32).max
+            ekey = jnp.where(et.emask, et.ev[:, 0] * capP + et.ev[:, 1],
+                             i32max)
+            ekey = jnp.sort(ekey)
+            pkey = kmin * capP + kmax
+            loc = jnp.searchsorted(ekey, pkey)
+            exists = ekey[jnp.clip(loc, 0, E - 1)] == pkey
+        else:
+            aa = jnp.concatenate([jnp.where(et.emask, et.ev[:, 0], 0),
+                                  kmin])
+            bb = jnp.concatenate([jnp.where(et.emask, et.ev[:, 1], 0),
+                                  kmax])
+            vv = jnp.concatenate([et.emask, base22])
+            order, _, _, first = sort_pairs(aa, bb, vv, capP)
+            is_edge = (order < E) & vv[order]
+            has_edge = segmented_or(first, is_edge.astype(jnp.uint32))
+            is_last = jnp.concatenate([first[1:], jnp.array([True])])
+            seg = jax.lax.associative_scan(
+                jnp.maximum, jnp.where(first, jnp.arange(2 * E), 0))
+            total = jnp.zeros(2 * E, jnp.uint32).at[
+                jnp.where(is_last, seg, 2 * E)].set(
+                has_edge, mode="drop", unique_indices=True)
+            exists = jnp.zeros(E, bool).at[
+                jnp.where(order >= E, order - E, E)].set(
+                total[seg] > 0, mode="drop")
+        base22 = base22 & ~exists
+    else:
+        newf = jnp.zeros(E, jnp.uint32)
+        newfr = jnp.zeros(E, jnp.int32)
+        newe22 = jnp.zeros(E, jnp.uint32)
+
+    # ---- 3-2 gate: the vanishing interior faces must be untagged ---------
+    if enable32:
+        from ..core.constants import EDGE_FACES
+        cfaces = jnp.asarray(EDGE_FACES)     # faces containing IARE edge
+        face_clean = jnp.ones(E, bool)
+        for rows, Pm in ((ft0, P0), (ft1r, P1), (ft2r, P2)):
+            lae = eof[Pm[:, 0], Pm[:, 1]]
+            for k in range(2):
+                face_clean = face_clean & \
+                    (fcol(rows, cfaces[lae, k]) == 0)
+        base32 = base32 & face_clean
+
+    cand = base32 | base22
+
+    # ---- geometric validity: a, b astride the new interior plane ---------
     def signed_vol(v0, v1, v2, v3):
-        p0, p1, p2, p3 = (mesh.vert[v0], mesh.vert[v1], mesh.vert[v2],
+        q0, q1, q2, q3 = (mesh.vert[v0], mesh.vert[v1], mesh.vert[v2],
                           mesh.vert[v3])
-        return jnp.sum((p1 - p0) * jnp.cross(p2 - p0, p3 - p0), -1)
+        return jnp.sum((q1 - q0) * jnp.cross(q2 - q0, q3 - q0), -1)
 
-    # validity: a and b strictly on opposite sides of plane (p,q,r) — the
-    # swapped pair tiles the shell union only then
-    vol_a = signed_vol(p, q, r, a)
-    vol_b = signed_vol(p, q, r, b)
-    cand = cand & (vol_a * vol_b < 0) & (jnp.abs(vol_a) > EPSD) & \
-        (jnp.abs(vol_b) > EPSD)
-    # same region on all shell tets
-    cand = cand & (mesh.tref[s0] == mesh.tref[s1]) & \
-        (mesh.tref[s0] == mesh.tref[s2])
+    sv_a = signed_vol(x0, x1, x2, a)
+    sv_b = signed_vol(x0, x1, x2, b)
+    cand = cand & (sv_a * sv_b < 0) & (jnp.abs(sv_a) > EPSD) & \
+        (jnp.abs(sv_b) > EPSD)
+    flip_a = sv_a < 0
+    flip_b = sv_b < 0
 
-    def orient_from_sign(v0, v1, v2, v3, vol):
-        neg = vol < 0
-        w0 = jnp.where(neg, v1, v0)
-        w1 = jnp.where(neg, v0, v1)
-        return jnp.stack([w0, w1, v2, v3], axis=1)      # [E,4]
+    def orient(v0, v1, v2, v3, flip):
+        w0 = jnp.where(flip, v1, v0)
+        w1 = jnp.where(flip, v0, v1)
+        return jnp.stack([w0, w1, v2, v3], axis=1)
 
-    new_a = orient_from_sign(p, q, r, a, vol_a)
-    new_b = orient_from_sign(p, q, r, b, vol_b)
+    new_a = orient(x0, x1, x2, a, flip_a)
+    new_b = orient(x0, x1, x2, b, flip_b)
 
-    def qual(tets):
-        pts = mesh.vert[tets]
-        return quality_from_points(pts, None if m6 is None else m6[tets])
-
-    # q_old via a per-tet quality table computed once (one [capT,4] gather)
-    # then three cheap 1-D gathers — not three full row-gather passes
-    q_tet = qual(mesh.tet)
-    q_old = jnp.minimum(jnp.minimum(q_tet[s0], q_tet[s1]), q_tet[s2])
-    q_new = jnp.minimum(qual(new_a), qual(new_b))
+    # ---- quality gate: one stacked call for both new tets ----------------
+    q_tet = quality_from_points(
+        mesh.vert[mesh.tet], None if m6 is None else m6[mesh.tet])
+    q_old = jnp.minimum(q_tet[s0], q_tet[s1])
+    q_old = jnp.minimum(q_old, jnp.where(base32, q_tet[s2], jnp.inf))
+    new_ab = jnp.concatenate([new_a, new_b])
+    q_ab = quality_from_points(
+        mesh.vert[new_ab], None if m6 is None else m6[new_ab])
+    q_new = jnp.minimum(q_ab[:E], q_ab[E:])
     cand = cand & (q_new > jnp.maximum(SWAP_GAIN * q_old, QUAL_FLOOR))
 
-    # --- claims: the 3 shell tets, exclusively (two-channel sort-free) ---
-    ps, pt = claim_channels(q_new - q_old, cand)
-    cl_s = jnp.full(capT + 1, NEG_INF)
-    for sh in (s0, s1, s2):
-        cl_s = cl_s.at[jnp.where(cand, sh, capT)].max(ps, mode="drop")
-    eq = cand
-    for sh in (s0, s1, s2):
-        eq = eq & (ps == cl_s[sh])
-    cl_t = jnp.full(capT + 1, PRI_MIN)
-    for sh in (s0, s1, s2):
-        cl_t = cl_t.at[jnp.where(eq, sh, capT)].max(pt, mode="drop")
-    # winners are pairwise shell-disjoint: two winners sharing a tet would
-    # both be that tet's pooled (s,t)-max — impossible, t is unique
-    win = eq
-    for sh in (s0, s1, s2):
-        win = win & (pt == cl_t[sh])
+    # ---- tag routing (base corner order (x0,x1,x2,y)) --------------------
+    # faces: col0 (opp x0) <- u2 opposite the vanished vertex; col1 <- u1;
+    # col2 <- s0 for 3-2 / the NEW boundary face for 2-2; col3 interior.
+    # edges (IARE): (x0x1, x0x2, x0y, x1x2, x1y, x2y).  A flip of
+    # (x0,x1) permutes face cols (0,1) and edge cols (0,3,4,1,2,5).
+    zero_u = jnp.zeros(E, jnp.uint32)
+    zero_i = jnp.zeros(E, jnp.int32)
 
-    # --- apply: overwrite slots t0,t1; kill t2 ---------------------------
-    tet = mesh.tet
-    tet = tet.at[jnp.where(win, s0, capT)].set(new_a, mode="drop")
-    tet = tet.at[jnp.where(win, s1, capT)].set(new_b, mode="drop")
-    tmask = mesh.tmask.at[jnp.where(win, s2, capT)].set(False, mode="drop")
-    # cavity was untagged: clear tags on rewritten slots
-    zero4 = jnp.zeros((et.ev.shape[0], 4), jnp.uint32)
-    zero6 = jnp.zeros((et.ev.shape[0], 6), jnp.uint32)
-    ftag = mesh.ftag
-    etag = mesh.etag
-    for s in (s0, s1):
-        ftag = ftag.at[jnp.where(win, s, capT)].set(zero4, mode="drop")
-        etag = etag.at[jnp.where(win, s, capT)].set(zero6, mode="drop")
+    def route_f(col0, col1, col2, zero, flip):
+        w0 = jnp.where(flip, col1, col0)
+        w1 = jnp.where(flip, col0, col1)
+        return jnp.stack([w0, w1, col2, zero], axis=1)
+
+    def route_e(cols, flip):
+        flipped = [cols[0], cols[3], cols[4], cols[1], cols[2], cols[5]]
+        return jnp.stack([jnp.where(flip, f, n)
+                          for n, f in zip(cols, flipped)], axis=1)
+
+    def routed(y_idx):
+        """Face/edge/ref routing for new tet (x0,x1,x2,y); y_idx: 0=a 1=b.
+
+        Inherited faces are the old faces OPPOSITE the vanished endpoint
+        (tet A keeps the faces that b vanished from), so face columns use
+        the other endpoint's positions; edges incident to y use y's own.
+        """
+        py0, py1, py2 = P0[:, y_idx], P1[:, y_idx], P2[:, y_idx]
+        po0, po1, po2 = (P0[:, 1 - y_idx], P1[:, 1 - y_idx],
+                         P2[:, 1 - y_idx])
+        ftag_n = route_f(
+            fcol(ft2r, po2), fcol(ft1r, po1),
+            jnp.where(base32, fcol(ft0, po0), newf), zero_u,
+            flip_a if y_idx == 0 else flip_b)
+        fref_n = route_f(
+            fcol(fr2r, po2), fcol(fr1r, po1),
+            jnp.where(base32, fcol(fr0, po0), newfr), zero_i,
+            flip_a if y_idx == 0 else flip_b)
+        e0 = jnp.where(base32, ecol(et0, P0[:, 2], P0[:, 3]), newe22)
+        e1 = ecol(et1r, P1[:, 2], P1[:, 4])
+        e2 = ecol(et0, P0[:, 2], py0)
+        e3 = ecol(et2r, P2[:, 3], P2[:, 4])
+        e4 = jnp.where(base32, ecol(et0, P0[:, 3], py0),
+                       ecol(et2r, P2[:, 3], py2))
+        e5 = ecol(et2r, P2[:, 4], py2) | \
+            jnp.where(base22, ecol(et0, P0[:, 4], py0), 0)
+        etag_n = route_e([e0, e1, e2, e3, e4, e5],
+                         flip_a if y_idx == 0 else flip_b)
+        return ftag_n, fref_n, etag_n
+
+    ftag_a, fref_a, etag_a = routed(0)
+    ftag_b, fref_b, etag_b = routed(1)
+
+    # ---- claims: s0, s1 (+ s2 for 3-2), exclusively ----------------------
+    s2eff = jnp.where(base32, s2, s0)        # duplicate claim is harmless
+    win = claim_shells(q_new - q_old, cand, (s0, s1, s2eff), capT)
+
+    if enable22:
+        # same-wave duplicate-diagonal veto: two 2-2 winners flipping to
+        # the SAME new edge (x0,x1) — disjoint shells, so claims allow it
+        # — would give that edge four boundary faces (non-manifold).  The
+        # pre-wave existence check cannot see same-wave creations; keep
+        # only the first winner per key (sort is ~free on this device).
+        from .edges import sort_pairs as _sp
+        win22 = win & base22
+        order_d, _, _, first_d = _sp(jnp.minimum(x0, x1),
+                                     jnp.maximum(x0, x1), win22, capP)
+        dup_sorted = win22[order_d] & ~first_d
+        dup = jnp.zeros(E, bool).at[order_d].set(dup_sorted,
+                                                 unique_indices=True)
+        win = win & ~dup
+
+    # ---- apply: one concatenated scatter per array -----------------------
+    w0i = jnp.where(win, s0, capT)
+    w1i = jnp.where(win, s1, capT)
+    idx2 = jnp.concatenate([w0i, w1i])
+    tet = mesh.tet.at[idx2].set(
+        jnp.concatenate([new_a, new_b]), mode="drop")
+    ftag = mesh.ftag.at[idx2].set(
+        jnp.concatenate([ftag_a, ftag_b]), mode="drop")
+    fref = mesh.fref.at[idx2].set(
+        jnp.concatenate([fref_a, fref_b]), mode="drop")
+    etag = mesh.etag.at[idx2].set(
+        jnp.concatenate([etag_a, etag_b]), mode="drop")
+    tmask = mesh.tmask.at[jnp.where(win & base32, s2, capT)].set(
+        False, mode="drop")
     nsw = jnp.sum(win.astype(jnp.int32))
     out = dataclasses.replace(mesh, tet=tet, tmask=tmask, ftag=ftag,
-                              etag=etag,
-                              nelem=mesh.nelem)  # count unchanged (masked)
+                              fref=fref, etag=etag, nelem=mesh.nelem)
     return SwapResult(out, nsw)
+
+
+def swap32_wave(mesh: Mesh, met: jax.Array) -> SwapResult:
+    """3-2 interior edge swap only (see swap_edges_wave)."""
+    return swap_edges_wave(mesh, met, enable32=True, enable22=False)
+
+
+def swap22_wave(mesh: Mesh, met: jax.Array,
+                flat_tol: float = 1e-5) -> SwapResult:
+    """2-2 boundary edge swap only (see swap_edges_wave)."""
+    return swap_edges_wave(mesh, met, enable32=False, enable22=True,
+                           flat_tol=flat_tol)
 
 
 def swap23_wave(mesh: Mesh, met: jax.Array) -> SwapResult:
@@ -164,13 +405,15 @@ def swap23_wave(mesh: Mesh, met: jax.Array) -> SwapResult:
     nf = adja & 3
     valid = (adja >= 0) & mesh.tmask[:, None]
     nb_s = jnp.clip(nb, 0, capT - 1)
-    # one candidate per interior face, owned by the lower tet id
+    # one candidate per interior face, owned by the lower tet id; the
+    # swapped face itself must be untagged (strictly interior) — exterior
+    # faces/edges of the cavity may be tagged, their tags are routed to
+    # the new fan below
     tid = jnp.arange(capT, dtype=jnp.int32)[:, None]
     own = valid & (tid < nb) & mesh.tmask[nb_s]
-    # untagged cavity
-    clean = (jnp.sum(mesh.ftag, axis=1) == 0) & \
-            (jnp.sum(mesh.etag, axis=1) == 0)
-    own = own & clean[:, None] & clean[nb_s]
+    nf_s = jnp.clip(nf, 0, 3)
+    own = own & (mesh.ftag == 0) & \
+        (mesh.ftag[nb_s, nf_s] == 0)
 
     flat = lambda x: x.reshape(-1)
     F = capT * 4
@@ -217,22 +460,26 @@ def swap23_wave(mesh: Mesh, met: jax.Array) -> SwapResult:
         pts = mesh.vert[tets]
         return quality_from_points(pts, None if m6 is None else m6[tets])
 
-    # per-tet quality computed once on [capT], then flat 1-D lookups
+    # per-tet quality computed once on [capT], then flat 1-D lookups;
+    # the 3 fan tets in ONE stacked call (per-op overhead dominates)
     q_tet = qual(mesh.tet)
     q_old = jnp.minimum(q_tet[t1], q_tet[t2])
-    q_new = jnp.minimum(jnp.minimum(qual(n1), qual(n2)), qual(n3))
+    q_fan = qual(jnp.concatenate([n1, n2, n3]))
+    q_new = jnp.minimum(jnp.minimum(q_fan[:F], q_fan[F:2 * F]),
+                        q_fan[2 * F:])
     cand = cand & pos & (q_new > jnp.maximum(SWAP_GAIN * q_old, QUAL_FLOOR))
 
     # --- claims on both tets (two-channel sort-free) ---------------------
-    ps, pt = claim_channels(q_new - q_old, cand)
-    cl_s = jnp.full(capT + 1, NEG_INF)
-    cl_s = cl_s.at[jnp.where(cand, t1, capT)].max(ps, mode="drop")
-    cl_s = cl_s.at[jnp.where(cand, t2, capT)].max(ps, mode="drop")
-    eq = cand & (ps == cl_s[t1]) & (ps == cl_s[t2])
-    cl_t = jnp.full(capT + 1, PRI_MIN)
-    cl_t = cl_t.at[jnp.where(eq, t1, capT)].max(pt, mode="drop")
-    cl_t = cl_t.at[jnp.where(eq, t2, capT)].max(pt, mode="drop")
-    win = eq & (pt == cl_t[t1]) & (pt == cl_t[t2])
+    win = claim_shells(q_new - q_old, cand, (t1, t2), capT)
+    # same-wave duplicate-edge veto: two winners whose fans both create
+    # edge (a,b) (a "lens" of two face-pairs between the same apexes)
+    # would put four tets on each (x,a,b) face; keep the first per key
+    from .edges import sort_pairs as _sp23
+    order_d, _, _, first_d = _sp23(jnp.minimum(a, b), jnp.maximum(a, b),
+                                   win, capP)
+    dup_sorted = win[order_d] & ~first_d
+    win = win & ~jnp.zeros(F, bool).at[order_d].set(
+        dup_sorted, unique_indices=True)
     w_i = win.astype(jnp.int32)
     off = jnp.cumsum(w_i) - w_i
     fits = off < (capT - mesh.nelem)
@@ -241,24 +488,61 @@ def swap23_wave(mesh: Mesh, met: jax.Array) -> SwapResult:
     off = jnp.cumsum(w_i) - w_i
     t3 = (mesh.nelem + off).astype(jnp.int32)
 
-    tet = mesh.tet
-    tet = tet.at[jnp.where(win, t1, capT)].set(n1, mode="drop")
-    tet = tet.at[jnp.where(win, t2, capT)].set(n2, mode="drop")
-    tet = tet.at[jnp.where(win, t3, capT)].set(n3, mode="drop")
+    # --- tag routing: the fan tet over ring edge (x,y) inherits the two
+    # exterior faces (x,y,a) [old T1, opposite the third ring vertex] and
+    # (x,y,b) [old T2]; ring and spoke edges keep their old tags; the new
+    # interior edge (a,b) and the two fan-internal faces are untagged.
+    eof = jnp.asarray(_EDGE_OF)
+    pos_p1 = idir[f1][:, 0]
+    pos_q1 = idir[f1][:, 1]
+    pos_r1 = idir[f1][:, 2]
+    # batched position lookup of (p,q,r) in T2: one comparison + argmax
+    eqm2 = tv2[:, None, :] == pqr[:, :, None]            # [F,3,4]
+    P2x = jnp.argmax(eqm2, axis=2).astype(jnp.int32)     # [F,3]
+    pos_p2, pos_q2, pos_r2 = P2x[:, 0], P2x[:, 1], P2x[:, 2]
+    zero_u = jnp.zeros(F, jnp.uint32)
+    zero_i = jnp.zeros(F, jnp.int32)
+
+    def route_f(arr, pos_opp1, pos_opp2, zero):
+        # new tet (x,y,a,b): col2 = (x,y,b) from T2, col3 = (x,y,a) from T1
+        return jnp.stack([zero, zero,
+                          arr[t2, pos_opp2], arr[t1, pos_opp1]], axis=1)
+
+    def route_e(pos_x1, pos_y1, pos_x2, pos_y2):
+        # (x,y,a,b) IARE edges: (xy, xa, xb, ya, yb, ab)
+        return jnp.stack([
+            mesh.etag[t1, eof[pos_x1, pos_y1]],
+            mesh.etag[t1, eof[pos_x1, f1]],
+            mesh.etag[t2, eof[pos_x2, f2]],
+            mesh.etag[t1, eof[pos_y1, f1]],
+            mesh.etag[t2, eof[pos_y2, f2]],
+            zero_u], axis=1)
+
+    ftag_n = [route_f(mesh.ftag, pos_r1, pos_r2, zero_u),
+              route_f(mesh.ftag, pos_p1, pos_p2, zero_u),
+              route_f(mesh.ftag, pos_q1, pos_q2, zero_u)]
+    fref_n = [route_f(mesh.fref, pos_r1, pos_r2, zero_i),
+              route_f(mesh.fref, pos_p1, pos_p2, zero_i),
+              route_f(mesh.fref, pos_q1, pos_q2, zero_i)]
+    etag_n = [route_e(pos_p1, pos_q1, pos_p2, pos_q2),
+              route_e(pos_q1, pos_r1, pos_q2, pos_r2),
+              route_e(pos_r1, pos_p1, pos_r2, pos_p2)]
+
+    # one concatenated scatter per array (per-op overhead dominates)
+    idx3 = jnp.concatenate([jnp.where(win, tt, capT) for tt in (t1, t2, t3)])
+    tet = mesh.tet.at[idx3].set(
+        jnp.concatenate([n1, n2, n3]), mode="drop")
     tmask = mesh.tmask.at[jnp.where(win, t3, capT)].set(True, mode="drop")
     tref3 = mesh.tref[t1]
     tref = mesh.tref.at[jnp.where(win, t3, capT)].set(tref3, mode="drop")
-    zero4 = jnp.zeros((F, 4), jnp.uint32)
-    zero6 = jnp.zeros((F, 6), jnp.uint32)
-    ftag, etag, fref = mesh.ftag, mesh.etag, mesh.fref
-    for tt in (t1, t2, t3):
-        ftag = ftag.at[jnp.where(win, tt, capT)].set(zero4, mode="drop")
-        etag = etag.at[jnp.where(win, tt, capT)].set(zero6, mode="drop")
-        fref = fref.at[jnp.where(win, tt, capT)].set(
-            zero4.astype(jnp.int32), mode="drop")
+    ftag = mesh.ftag.at[idx3].set(jnp.concatenate(ftag_n), mode="drop")
+    etag = mesh.etag.at[idx3].set(jnp.concatenate(etag_n), mode="drop")
+    fref = mesh.fref.at[idx3].set(jnp.concatenate(fref_n), mode="drop")
     nsw = jnp.sum(w_i)
     nelem = mesh.nelem + nsw
     out = dataclasses.replace(mesh, tet=tet, tmask=tmask, tref=tref,
                               ftag=ftag, etag=etag, fref=fref,
                               nelem=nelem.astype(jnp.int32))
     return SwapResult(out, nsw)
+
+

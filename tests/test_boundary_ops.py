@@ -1,0 +1,162 @@
+"""Boundary-aware operators: 2-2 boundary edge swap (MMG5_swpbdy) and
+tangential relocation of regular surface points (MMG5_movbdyregpt)."""
+import numpy as np
+import jax.numpy as jnp
+
+from parmmg_tpu.core.mesh import make_mesh, tet_volumes
+from parmmg_tpu.core import constants as C
+from parmmg_tpu.ops.adjacency import (
+    build_adjacency, check_adjacency)
+from parmmg_tpu.ops.analysis import analyze_mesh
+from parmmg_tpu.ops.swap import swap22_wave
+from parmmg_tpu.ops.smooth import smooth_wave
+from parmmg_tpu.ops.quality import tet_quality
+from parmmg_tpu.utils.fixtures import cube_mesh
+
+
+def _two_tet_quad():
+    """Two thin tets over a planar boundary quad: swapping the surface
+    diagonal (a,b) -> (p,q) fattens both."""
+    vert = np.array([
+        [-2.0, 0.0, 0.0],   # 0 = a
+        [2.0, 0.0, 0.0],    # 1 = b
+        [0.0, 0.8, 0.0],    # 2 = p
+        [0.0, -0.8, 0.0],   # 3 = q
+        [0.0, 0.0, 1.2],    # 4 = c (apex)
+    ], np.float64)
+    # T1 = {a,b,c,p}, T2 = {a,b,c,q}, both positively oriented
+    tet = np.array([[0, 1, 2, 4], [0, 1, 4, 3]], np.int32)
+    m = make_mesh(vert, tet, capP=16, capT=8)
+    return analyze_mesh(m).mesh
+
+
+def test_swap22_flips_boundary_diagonal():
+    m = _two_tet_quad()
+    met = jnp.full(m.capP, 1.0)
+    vol0 = float(np.asarray(tet_volumes(m))[np.asarray(m.tmask)].sum())
+    q0 = np.asarray(tet_quality(m))[np.asarray(m.tmask)].min()
+
+    res = swap22_wave(m, met)
+    assert int(res.nswap) == 1
+    m2 = build_adjacency(res.mesh)
+    assert check_adjacency(m2) == {"asymmetric": 0, "face_mismatch": 0}
+
+    tm = np.asarray(m2.tmask)
+    tv = np.asarray(m2.tet)[tm]
+    # both tets now contain the flipped diagonal (p, q) = (2, 3)
+    for t in tv:
+        assert 2 in t and 3 in t
+    # the old diagonal (a, b) is gone
+    assert not any((0 in t) and (1 in t) for t in tv)
+    # volume and count conserved, quality strictly improved
+    vols = np.asarray(tet_volumes(m2))[tm]
+    assert (vols > 0).all()
+    assert np.isclose(vols.sum(), vol0, rtol=1e-12)
+    q1 = np.asarray(tet_quality(m2))[tm].min()
+    assert q1 > q0
+
+    # tag routing: new diagonal is a boundary edge, the two new surface
+    # faces are tagged MG_BDY, and the interior face is untagged
+    from parmmg_tpu.ops.edges import unique_edges
+    et = unique_edges(m2)
+    ev = np.asarray(et.ev)
+    etag = np.asarray(et.etag)
+    emask = np.asarray(et.emask)
+    diag = emask & (ev[:, 0] == 2) & (ev[:, 1] == 3)
+    assert diag.any() and (etag[diag] & C.MG_BDY).all()
+    ftag = np.asarray(m2.ftag)[tm]
+    nbdy_faces = int(((ftag & C.MG_BDY) != 0).sum())
+    assert nbdy_faces == 6        # all faces boundary except the shared one
+
+
+def test_swap22_respects_frozen_edges():
+    m = _two_tet_quad()
+    # freeze the swappable edge (a,b) = (0,1): tag REQ on every slot
+    ev = np.array([[0, 1]])
+    etag = np.asarray(m.etag).copy()
+    tv = np.asarray(m.tet)
+    from parmmg_tpu.core.constants import IARE
+    for t in range(2):
+        for e, (i, j) in enumerate(IARE):
+            pair = {tv[t, i], tv[t, j]}
+            if pair == {0, 1}:
+                etag[t, e] |= C.MG_REQ
+    import dataclasses
+    m = dataclasses.replace(m, etag=jnp.asarray(etag))
+    res = swap22_wave(m, jnp.full(m.capP, 1.0))
+    assert int(res.nswap) == 0
+
+
+def test_swap22_in_cube_keeps_surface():
+    """Run swap22 waves on an adapted-ish cube: conformity + exact volume."""
+    vert, tet = cube_mesh(3)
+    m = make_mesh(vert, tet, capP=4 * len(vert), capT=4 * len(tet))
+    m = analyze_mesh(m).mesh
+    met = jnp.full(m.capP, 0.5)
+    total = 0
+    for _ in range(4):
+        res = swap22_wave(m, met)
+        m = build_adjacency(res.mesh)
+        total += int(res.nswap)
+        if int(res.nswap) == 0:
+            break
+    assert check_adjacency(m) == {"asymmetric": 0, "face_mismatch": 0}
+    vols = np.asarray(tet_volumes(m))[np.asarray(m.tmask)]
+    assert (vols > 0).all()
+    assert np.isclose(vols.sum(), 1.0, rtol=1e-10)
+    # boundary vertices all still on the unit-cube surface
+    vm = np.asarray(m.vmask)
+    vtag = np.asarray(m.vtag)
+    bdy = vm & ((vtag & C.MG_BDY) != 0)
+    vv = np.asarray(m.vert)[bdy]
+    on_surf = (np.isclose(vv, 0.0, atol=1e-9) |
+               np.isclose(vv, 1.0, atol=1e-9)).any(axis=1)
+    assert on_surf.all()
+
+
+def test_boundary_smooth_moves_surface_points_in_plane():
+    """A perturbed-in-plane cube face relaxes; off-plane never happens."""
+    vert, tet = cube_mesh(4)
+    rng = np.random.default_rng(0)
+    # perturb interior points of the z=0 face tangentially
+    on_face = np.isclose(vert[:, 2], 0.0)
+    inner = on_face & (vert[:, 0] > 0.01) & (vert[:, 0] < 0.99) & \
+        (vert[:, 1] > 0.01) & (vert[:, 1] < 0.99)
+    vert = vert.copy()
+    vert[inner, :2] += rng.uniform(-0.07, 0.07, (inner.sum(), 2))
+    m = make_mesh(vert, tet, capP=2 * len(vert), capT=2 * len(tet))
+    m = analyze_mesh(m).mesh
+    met = jnp.full(m.capP, 0.4)
+
+    q0 = np.asarray(tet_quality(m))[np.asarray(m.tmask)].min()
+    moved = 0
+    for w in range(6):
+        res = smooth_wave(m, met, wave=w)
+        m = res.mesh
+        moved += int(res.nmoved)
+    assert moved > 0
+    # every z=0-face vertex is still exactly on z=0 (tangential moves only)
+    vm = np.asarray(m.vmask)
+    vv = np.asarray(m.vert)
+    still_face = vm[: len(vert)] & on_face
+    assert np.allclose(vv[: len(vert)][still_face][:, 2], 0.0, atol=1e-7)
+    m = build_adjacency(m)
+    vols = np.asarray(tet_volumes(m))[np.asarray(m.tmask)]
+    assert (vols > 0).all()
+    assert np.isclose(vols.sum(), 1.0, rtol=1e-6)
+    q1 = np.asarray(tet_quality(m))[np.asarray(m.tmask)].min()
+    assert q1 >= q0
+
+
+def test_boundary_smooth_freezes_ridges_and_corners():
+    vert, tet = cube_mesh(3)
+    m = make_mesh(vert, tet, capP=2 * len(vert), capT=2 * len(tet))
+    m = analyze_mesh(m).mesh
+    met = jnp.full(m.capP, 0.4)
+    v0 = np.asarray(m.vert).copy()
+    vtag = np.asarray(m.vtag)
+    for w in range(4):
+        m = smooth_wave(m, met, wave=w).mesh
+    v1 = np.asarray(m.vert)
+    frozen = (vtag & (C.MG_CRN | C.MG_GEO | C.MG_REQ)) != 0
+    assert np.allclose(v0[frozen], v1[frozen])
