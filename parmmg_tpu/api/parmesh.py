@@ -560,7 +560,9 @@ class ParMesh:
         self._out_edges_cache = None
         self._out_tria_cache = None
         self._out_ftag_cache = None
-        return C.PMMG_SUCCESS
+        # graded failure: the staged output above IS the saveable
+        # conforming mesh (failed_handling, libparmmg1.c:974-1011)
+        return stats.status
 
     # ------------------------------------------------------------------
     # output getters

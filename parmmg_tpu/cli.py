@@ -216,6 +216,14 @@ def main(argv=None) -> int:
 
     ret = pm.run()
     dt = time.perf_counter() - t0
+    if ret == C.PMMG_LOWFAILURE:
+        # a conforming mesh was produced despite the partial failure —
+        # save it and exit nonzero (the reference CLI's LOWFAILURE path)
+        print("adaptation INCOMPLETE (low failure): saving the last "
+              "conforming mesh", file=sys.stderr)
+        if not args.noout:
+            _save_outputs(pm, args)
+        return ret
     if ret != C.PMMG_SUCCESS:
         print(f"adaptation FAILED ({ret})", file=sys.stderr)
         return ret

@@ -152,27 +152,59 @@ def parmmg_run(pm) -> tuple[Mesh, object, AdaptStats]:
     if info.n_devices <= 1:
         niter = max(1, info.niter)
         for it in range(niter):
-            with tim(f"adaptation"):
-                mesh, met, st = adapt_mesh(
-                    mesh, met,
-                    verbose=3 if info.imprim >= C.PMMG_VERB_ITWAVES else 0,
-                    noinsert=info.noinsert, noswap=info.noswap,
-                    nomove=info.nomove, angedg=angedg)
+            try:
+                with tim(f"adaptation"):
+                    mesh, met, st = adapt_mesh(
+                        mesh, met,
+                        verbose=3 if info.imprim >= C.PMMG_VERB_ITWAVES
+                        else 0,
+                        noinsert=info.noinsert, noswap=info.noswap,
+                        nomove=info.nomove, angedg=angedg)
+            except MemoryError:
+                # capacity exhausted mid-iteration: the pre-iteration
+                # mesh binding is still conforming — degrade, don't die
+                # (failed_handling, libparmmg1.c:974-1011)
+                stats.status = C.PMMG_LOWFAILURE
+                break
+            except Exception as e:  # device OOM comes as XlaRuntimeError
+                if "RESOURCE_EXHAUSTED" not in str(e) and \
+                        "Out of memory" not in str(e):
+                    raise
+                stats.status = C.PMMG_LOWFAILURE
+                break
             stats += st
     else:
         from .parallel.dist import distributed_adapt
         from .parallel.partition import move_interfaces
-        from .ops.analysis import analyze_mesh
+        from .parallel.dist import ShardOverflowError
         part = None
         niter = max(1, info.niter)
         for it in range(niter):
-            with tim("adaptation"):
-                mesh, met, part = distributed_adapt(
-                    mesh, met, info.n_devices, part=part,
-                    verbose=3 if info.imprim >= C.PMMG_VERB_ITWAVES else 0,
-                    stats=stats, noinsert=info.noinsert,
-                    noswap=info.noswap, nomove=info.nomove)
-                mesh = analyze_mesh(mesh, angedg).mesh
+            try:
+                with tim("adaptation"):
+                    # tags (ridge/corner/ref classification included) are
+                    # maintained through the shards: distributed_adapt
+                    # runs the cross-shard analysis refresh before
+                    # merging, so no whole-mesh re-analysis happens here
+                    # (the PMMG_update_analys design, analys_pmmg.c:1571)
+                    mesh, met, part = distributed_adapt(
+                        mesh, met, info.n_devices, part=part,
+                        verbose=3 if info.imprim >= C.PMMG_VERB_ITWAVES
+                        else 0,
+                        stats=stats, noinsert=info.noinsert,
+                        noswap=info.noswap, nomove=info.nomove,
+                        angedg=angedg)
+            except ShardOverflowError as e:
+                # degrade to LOWFAILURE with the conforming merged state
+                # (failed_handling, libparmmg1.c:974-1011)
+                mesh, met, part = e.mesh, e.met, e.part
+                stats.status = C.PMMG_LOWFAILURE
+                if info.imprim >= 0:
+                    import sys
+                    print("  ## Warning: shard capacity exhausted; "
+                          "saving the last conforming mesh "
+                          "(LOWFAILURE).", file=sys.stderr)
+                break
             if it + 1 < niter and not info.nobalancing \
                     and info.repartitioning == C.REPART_IFC_DISPLACEMENT:
                 # displace old interfaces into shard interiors so the

@@ -41,6 +41,9 @@ class AdaptStats:
     nmoved: int = 0
     cycles: int = 0
     regrows: int = 0
+    # PMMG_SUCCESS unless the run degraded (failed_handling contract:
+    # PMMG_LOWFAILURE = something failed but a conforming mesh is saved)
+    status: int = 0
 
     def __iadd__(self, other):
         self.nsplit += other.nsplit
@@ -49,6 +52,7 @@ class AdaptStats:
         self.nmoved += other.nmoved
         self.cycles += other.cycles
         self.regrows += other.regrows
+        self.status = max(self.status, other.status)
         return self
 
 

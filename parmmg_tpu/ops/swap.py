@@ -405,31 +405,38 @@ def swap23_wave(mesh: Mesh, met: jax.Array) -> SwapResult:
     nf = adja & 3
     valid = (adja >= 0) & mesh.tmask[:, None]
     nb_s = jnp.clip(nb, 0, capT - 1)
-    # one candidate per interior face, owned by the lower tet id; the
-    # swapped face itself must be untagged (strictly interior) — exterior
-    # faces/edges of the cavity may be tagged, their tags are routed to
-    # the new fan below
+    # candidate faces, owned by the lower tet id; the swapped face itself
+    # must be untagged (strictly interior) — exterior faces/edges of the
+    # cavity may be tagged, their tags are routed to the new fan below
     tid = jnp.arange(capT, dtype=jnp.int32)[:, None]
     own = valid & (tid < nb) & mesh.tmask[nb_s]
     nf_s = jnp.clip(nf, 0, 3)
     own = own & (mesh.ftag == 0) & \
         (mesh.ftag[nb_s, nf_s] == 0)
 
-    flat = lambda x: x.reshape(-1)
-    F = capT * 4
-    t1 = jnp.repeat(jnp.arange(capT, dtype=jnp.int32), 4)
-    f1 = jnp.tile(jnp.arange(4, dtype=jnp.int32), capT)
-    t2 = flat(nb_s)
-    f2 = flat(nf)
-    cand = flat(own)
+    # per-tet quality once; ONE candidate face per tet — the face toward
+    # the worst neighbor.  Shrinks every downstream array from [4T] to
+    # [T] (gather/scatter throughput is the cycle's cost ceiling on this
+    # device); waves repeat, so the restriction only staggers swaps
+    q_tet = quality_from_points(
+        mesh.vert[mesh.tet], None if m6 is None else m6[mesh.tet])
+    q_nb = jnp.where(own, q_tet[nb_s], jnp.inf)          # [T,4]
+    fstar = jnp.argmin(q_nb, axis=1).astype(jnp.int32)   # [T]
+    F = capT
+    ar = jnp.arange(capT)
+    t1 = ar.astype(jnp.int32)
+    f1 = fstar
+    t2 = nb_s[ar, fstar]
+    f2 = nf_s[ar, fstar]
+    cand = own[ar, fstar]
 
     from ..core.constants import IDIR
     idir = jnp.asarray(IDIR)
-    tv1 = mesh.tet[t1]                                   # [F,4]
+    tv1 = mesh.tet                                       # [T,4]
     tv2 = mesh.tet[t2]
-    pqr = tv1[jnp.arange(F)[:, None], idir[f1]]          # [F,3]
-    a = tv1[jnp.arange(F), f1]                           # apex in T1
-    b = tv2[jnp.arange(F), f2]                           # apex in T2
+    pqr = tv1[ar[:, None], idir[f1]]                     # [T,3]
+    a = tv1[ar, f1]                                      # apex in T1
+    b = tv2[ar, f2]                                      # apex in T2
 
     p, q, r = pqr[:, 0], pqr[:, 1], pqr[:, 2]
 
@@ -460,9 +467,7 @@ def swap23_wave(mesh: Mesh, met: jax.Array) -> SwapResult:
         pts = mesh.vert[tets]
         return quality_from_points(pts, None if m6 is None else m6[tets])
 
-    # per-tet quality computed once on [capT], then flat 1-D lookups;
     # the 3 fan tets in ONE stacked call (per-op overhead dominates)
-    q_tet = qual(mesh.tet)
     q_old = jnp.minimum(q_tet[t1], q_tet[t2])
     q_fan = qual(jnp.concatenate([n1, n2, n3]))
     q_new = jnp.minimum(jnp.minimum(q_fan[:F], q_fan[F:2 * F]),

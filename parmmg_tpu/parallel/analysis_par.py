@@ -36,10 +36,36 @@ from ..core.constants import (
 from .comms import InterfaceComms, global_node_numbering
 
 
+def extend_numbering(comms: InterfaceComms, npoin_new: list[int]
+                     ) -> list[np.ndarray]:
+    """Global numbering for ADAPTED shards: comm-table vertices keep the
+    split-time numbering (interfaces are frozen, so slots are stable);
+    vertices created by adaptation get fresh, globally-unique ids (they
+    are shard-private by the freeze contract).  The PMMG_update_analys
+    prerequisite (analys_pmmg.c:1571): entity matching across shards
+    stays keyed by the pre-adaptation numbering."""
+    base = global_node_numbering(comms, [len(o) for o in comms.owner])
+    top = max((int(g.max()) if len(g) else 0) for g in base) + 1
+    out = []
+    for s, g in enumerate(base):
+        extra = npoin_new[s] - len(g)
+        ext = np.concatenate([
+            g, top + np.arange(max(0, extra), dtype=np.int64)])
+        top += max(0, extra)
+        out.append(ext)
+    return out
+
+
 def analyze_shards(verts: list[np.ndarray], tets: list[np.ndarray],
                    ftags: list[np.ndarray], frefs: list[np.ndarray],
-                   comms: InterfaceComms, angedg: float = ANGEDG):
+                   comms: InterfaceComms, angedg: float = ANGEDG,
+                   glo: list[np.ndarray] | None = None):
     """Cross-shard surface analysis.
+
+    ``glo`` overrides the global numbering — required when shards have
+    grown past the comm tables' vertex range (adaptation creates
+    shard-private vertices; give them unique global ids, see
+    ``extend_numbering``).
 
     Returns per-shard:
       vtag_add[s]    uint32 bits (MG_BDY/GEO/CRN/REF/NOM) for vertices,
@@ -47,7 +73,8 @@ def analyze_shards(verts: list[np.ndarray], tets: list[np.ndarray],
       vnormal[s]     [np,3] unit outward normals (0 off-surface).
     """
     S = len(verts)
-    glo = global_node_numbering(comms, [len(v) for v in verts])
+    if glo is None:
+        glo = global_node_numbering(comms, [len(v) for v in verts])
 
     # ---- collect boundary-face edge records per shard -------------------
     # rec: (gkey_lo, gkey_hi, local_a, local_b, nx, ny, nz, fref, shard)

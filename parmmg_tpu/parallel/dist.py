@@ -31,6 +31,23 @@ from ..core.mesh import Mesh
 from ..ops.quality import tet_quality, quality_histogram
 
 
+MAX_SHARD_REGROWS = 6
+
+
+class ShardOverflowError(RuntimeError):
+    """Shard capacity exhausted after MAX_SHARD_REGROWS doublings.
+
+    Carries the last CONFORMING merged state so the caller can degrade
+    to PMMG_LOWFAILURE and still save a valid mesh — the reference's
+    failed_handling contract (libparmmg1.c:974-1011)."""
+
+    def __init__(self, mesh, met, part):
+        super().__init__("shard capacity overflow")
+        self.mesh = mesh
+        self.met = met
+        self.part = part
+
+
 def _unstack(pytree):
     return jax.tree.map(lambda x: x[0], pytree)
 
@@ -85,6 +102,114 @@ def dist_adapt_cycle(dmesh: DeviceMesh, do_swap: bool = True,
     return jax.jit(fn)
 
 
+def dist_interface_check(dmesh: DeviceMesh):
+    """On-device interface echo (PMMG_check_extNodeComm on the jittable
+    exchange): every shard sends its interface vertices' coordinates +
+    metric through :func:`halo_exchange` and compares against the mirror
+    side; the psum'd mismatch count must be zero.  Production guard for
+    the ordering contract of the comm tables — runs once per outer
+    iteration in distributed_adapt.
+
+    Returns fn(stacked_mesh, stacked_met, node_idx[S,K,I], nbr[S,K],
+    tol) -> global mismatch count.
+    """
+    from .comms import halo_exchange
+    spec = P("shard")
+
+    def local(mesh_s: Mesh, met_s, node_idx_s, nbr_s, tol):
+        mesh = _unstack(mesh_s)
+        met = met_s[0]
+        node_idx = node_idx_s[0]
+        nbr = nbr_s[0]
+        m2 = met[:, None] if met.ndim == 1 else met
+        vals = jnp.concatenate([mesh.vert, m2.astype(mesh.vert.dtype)],
+                               axis=1)                     # [capP, 3+m]
+        recv = halo_exchange(vals, node_idx, nbr)          # [K, I, 3+m]
+        mine = vals[jnp.clip(node_idx, 0, mesh.capP - 1)]
+        valid = (node_idx >= 0)[..., None]
+        bad = valid & (jnp.abs(recv - jnp.where(valid, mine, 0)) > tol)
+        n_bad = jnp.sum(bad.astype(jnp.int32))
+        return jax.lax.psum(n_bad, "shard")
+
+    fn = shard_map(local, mesh=dmesh,
+                   in_specs=(spec, spec, spec, spec, P()),
+                   out_specs=P(), check_vma=False)
+    return jax.jit(fn)
+
+
+def refresh_shard_analysis(stacked: Mesh, comms, n_shards: int,
+                           angedg: float):
+    """Cross-shard surface analysis refresh on ADAPTED shards — the
+    production PMMG_update_analys analogue (analys_pmmg.c:1571): ridge /
+    corner / reference classification is recomputed with cross-interface
+    dihedrals (a shard cannot see the other side's face normals), then
+    written back into the stacked shard tags before the merge.
+
+    Interface slots are stable under adaptation (frozen entities are
+    never collapsed and slots are not compacted in-cycle), so the
+    split-time comm tables remain valid — the reference relies on the
+    same invariant between migrations.
+    """
+    import dataclasses
+    from ..core.constants import (
+        MG_BDY, MG_CRN, MG_GEO, MG_NOM, MG_PARBDY, MG_REF)
+    from .analysis_par import analyze_shards, extend_numbering
+
+    capP = stacked.vert.shape[1]
+    verts, tets, ftags, frefs, tms = [], [], [], [], []
+    for s in range(n_shards):
+        tm = np.asarray(stacked.tmask[s])
+        verts.append(np.asarray(stacked.vert[s]))
+        tets.append(np.asarray(stacked.tet[s])[tm].astype(np.int64))
+        ftags.append(np.asarray(stacked.ftag[s])[tm])
+        frefs.append(np.asarray(stacked.fref[s])[tm])
+        tms.append(tm)
+    glo = extend_numbering(comms, [capP] * n_shards)
+    vtag_add, special_edges, _ = analyze_shards(
+        verts, tets, ftags, frefs, comms, angedg, glo=glo)
+
+    CLS = np.uint32(MG_GEO | MG_CRN | MG_REF | MG_NOM)
+    new_vtag = []
+    new_etag = []
+    for s in range(n_shards):
+        vt = np.asarray(stacked.vtag[s]).copy()
+        add = vtag_add[s].astype(np.uint32)
+        # re-derive the classification bits; never drop freeze/user bits
+        vt = (vt & ~CLS) | (add & CLS) | (add & MG_BDY)
+        new_vtag.append(vt)
+        # edges: clear stale classification on plain boundary edges, then
+        # re-apply the global special-edge set (vectorized keyed lookup)
+        from ..core.constants import IARE
+        et = np.asarray(stacked.etag[s]).copy()
+        tm = tms[s]
+        tth = np.asarray(stacked.tet[s]).astype(np.int64)
+        evl = np.sort(tth[:, IARE], axis=2)[tm]            # [nt,6,2]
+        live_rows = np.where(tm)[0]
+        plain_bdy = ((et[tm] & MG_BDY) != 0) & ((et[tm] & MG_PARBDY) == 0)
+        cleared = et[tm] & ~np.where(plain_bdy, CLS, np.uint32(0))
+        rows = special_edges[s]
+        if len(rows):
+            ka = np.minimum(rows[:, 0], rows[:, 1]).astype(np.int64)
+            kb = np.maximum(rows[:, 0], rows[:, 1]).astype(np.int64)
+            skey = ka * capP + kb
+            o = np.argsort(skey, kind="stable")
+            sk, sb = skey[o], rows[:, 2][o].astype(np.uint32)
+            heads = np.concatenate([[True], sk[1:] != sk[:-1]])
+            uk = sk[heads]
+            ub = np.bitwise_or.reduceat(sb, np.where(heads)[0]) \
+                if len(sk) else sb
+            ekey = evl[..., 0] * capP + evl[..., 1]        # [nt,6]
+            loc = np.clip(np.searchsorted(uk, ekey), 0, len(uk) - 1)
+            hit = uk[loc] == ekey
+            cleared |= np.where(hit, ub[loc], 0).astype(np.uint32)
+        et[live_rows] = cleared
+        new_etag.append(et)
+    return dataclasses.replace(
+        stacked,
+        vtag=jnp.asarray(np.stack(new_vtag)),
+        etag=jnp.asarray(np.stack(new_etag)))
+
+
 def dist_quality(dmesh: DeviceMesh):
     """Global quality histogram across shards (PMMG_qualhisto analogue,
     quality_pmmg.c:156 — the custom MPI_Op reduction becomes psum/pmin)."""
@@ -113,18 +238,21 @@ def distributed_adapt(mesh: Mesh, met, n_shards: int,
                       partitioner: str = "morton", verbose: int = 0,
                       part: np.ndarray | None = None, stats=None,
                       noinsert: bool = False, noswap: bool = False,
-                      nomove: bool = False):
+                      nomove: bool = False, angedg: float | None = None):
     """One outer remesh pass on n_shards devices (host driver).
 
-    partition (or take the caller's displaced ``part``) -> freeze
-    interfaces -> SPMD adapt cycles -> merge.  Returns
-    (merged mesh, met, part_of_merged): the partition labels of the NEW
-    tets (= source shard), which the caller displaces with
-    ``move_interfaces`` before the next outer iteration — the
+    partition (metric-weighted, boundary-refined; or take the caller's
+    displaced ``part``) -> freeze interfaces -> on-device interface echo
+    check -> SPMD adapt cycles -> cross-shard surface analysis refresh ->
+    merge.  Returns (merged mesh, met, part_of_merged): the partition
+    labels of the NEW tets (= source shard), which the caller displaces
+    with ``move_interfaces`` before the next outer iteration — the
     remesh-and-repartition scheme of PMMG_parmmglib1/loadbalancing.
     """
     from ..core.mesh import tet_volumes, mesh_to_host
-    from .partition import morton_partition, greedy_partition, fix_contiguity
+    from .partition import (morton_partition, greedy_partition,
+                            fix_contiguity, metric_edge_weights,
+                            refine_partition)
     from .distribute import split_to_shards, merge_shards
 
     if dmesh is None:
@@ -138,6 +266,13 @@ def distributed_adapt(mesh: Mesh, met, n_shards: int,
         else:
             part = greedy_partition(tet, cent, n_shards)
         part = fix_contiguity(tet, part)
+        # metric-aware cut refinement (PMMG_computeWgt role,
+        # metis_pmmg.c:280): keep the interface out of regions whose
+        # edges are far from unit metric length
+        methost = np.asarray(met)[np.asarray(mesh.vmask)]
+        wd = metric_edge_weights(tet, vert, methost)
+        part = fix_contiguity(tet, refine_partition(
+            part, n_shards, wd["pairs"], wd["w"]))
 
     cap_mult = 3.0
     step_full = dist_adapt_cycle(dmesh, do_swap=not noswap,
@@ -149,14 +284,40 @@ def distributed_adapt(mesh: Mesh, met, n_shards: int,
         dmesh, do_swap=False, do_smooth=not nomove,
         do_insert=not noinsert)
     stacked = met_s = None
+    comms = None
+    vert_h, tet_h = vert, tet        # kept in sync with `mesh` (regrows)
     c = 0
     regrows = 0
     while c < cycles:
         if stacked is None:
-            s, ms = split_to_shards(mesh, met, part, n_shards,
-                                    cap_mult=cap_mult)
+            s, ms, l2g = split_to_shards(mesh, met, part, n_shards,
+                                         cap_mult=cap_mult,
+                                         return_l2g=True)
             stacked = shard_stacked(s, dmesh)
             met_s = shard_stacked(ms, dmesh)
+            # comm tables (communicators_pmmg.c role) + the on-device
+            # interface echo: exchange interface coordinates+metric over
+            # halo_exchange and require exact mirror agreement — the
+            # production chkcomm guard for the ordering contract
+            from .comms import build_interface_comms
+            g2l = []
+            for s_ in range(n_shards):
+                mmap = np.full(len(vert_h), -1, np.int64)
+                mmap[l2g[s_]] = np.arange(len(l2g[s_]))
+                g2l.append(mmap)
+            comms = build_interface_comms(tet_h, part, n_shards, l2g, g2l)
+            chk = dist_interface_check(dmesh)
+            diag = float(np.linalg.norm(vert_h.max(0) - vert_h.min(0))) \
+                if len(vert_h) else 1.0
+            nbad = int(chk(
+                stacked, met_s,
+                shard_stacked(jnp.asarray(comms.node_idx), dmesh),
+                shard_stacked(jnp.asarray(comms.nbr), dmesh),
+                jnp.asarray(1e-6 * diag, s.vert.dtype)))
+            if nbad:
+                raise RuntimeError(
+                    f"interface comm echo mismatch: {nbad} items "
+                    "(ordering contract violated)")
         # swaps every 3rd cycle (see ops.adapt.adapt_mesh) and on the
         # final two (quality polish before the merge)
         step = step_full if (c % 3 == 2 or c >= cycles - 2) else step_light
@@ -176,18 +337,28 @@ def distributed_adapt(mesh: Mesh, met, n_shards: int,
             # shard capacity exhausted: merge, double headroom, re-split
             # with the same partition and continue (the static-shape
             # analogue of the reference's realloc/memory repartition,
-            # zaldy_pmmg.c:140-254)
-            if regrows >= 6:
-                raise MemoryError("shard capacity overflow")
+            # zaldy_pmmg.c:140-254).  Past the regrow cap, degrade to a
+            # LOWFAILURE with the conforming merged state instead of
+            # dying (failed_handling, libparmmg1.c:974-1011).
             mesh, met, part = merge_shards(stacked, met_s,
                                            return_part=True)
+            if regrows >= MAX_SHARD_REGROWS:
+                raise ShardOverflowError(mesh, met, part)
             cap_mult *= 2.0
             regrows += 1
+            vert_h, tet_h, _, _, _ = mesh_to_host(mesh)
             stacked = None
             continue
         c += 1
         if step is step_full and cs[0] == 0 and cs[1] == 0 and cs[2] == 0:
             break
+    # cross-shard surface analysis refresh (PMMG_update_analys analogue)
+    # BEFORE the merge: ridge/corner/ref classification with
+    # cross-interface dihedrals, written into the shard tags so the
+    # merged mesh needs no whole-mesh re-analysis
+    from ..core.constants import ANGEDG
+    stacked = refresh_shard_analysis(
+        stacked, comms, n_shards, ANGEDG if angedg is None else angedg)
     merged, met_m, part_new = merge_shards(stacked, met_s,
                                            return_part=True)
     return merged, met_m, part_new

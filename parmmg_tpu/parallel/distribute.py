@@ -30,18 +30,30 @@ from ..ops.adjacency import build_adjacency, boundary_edge_tags
 
 
 def split_to_shards(mesh: Mesh, met, part: np.ndarray, nparts: int,
-                    cap_mult: float = 3.0):
+                    cap_mult: float = 3.0, return_l2g: bool = False):
     """Split a host-resident Mesh into ``nparts`` shard Meshes (stacked).
 
-    Returns (shards: Mesh with leading axis [nparts, ...], met stacked,
-    None).  All shards share one capacity (max over shards * cap_mult /
-    nparts-balance) so they stack into one pytree for shard_map.
+    Returns (shards: Mesh with leading axis [nparts, ...], met stacked),
+    plus the per-shard local->global vertex maps when ``return_l2g`` (the
+    input to build_interface_comms).  All shards share one capacity (max
+    over shards * cap_mult / nparts-balance) so they stack into one
+    pytree for shard_map.
     """
     vert, tet, vref, tref, vtag = mesh_to_host(mesh)
     methost = np.asarray(met)
     vm = np.asarray(mesh.vmask)
+    tm = np.asarray(mesh.tmask)
     new_id = np.cumsum(vm) - 1
     methost = methost[vm]
+    # per-tet face/edge tags + refs travel with the tets: ridge (MG_GEO)
+    # and reference data must survive the split — the waves rely on edge
+    # tags for the freeze contract, and fref is user data (the reference
+    # ships whole MMG5_xTetra records in the group pack,
+    # mpipack_pmmg.c:~400; dropping them here silently eroded ridges in
+    # the distributed path)
+    ftag_h = np.asarray(mesh.ftag)[tm]
+    fref_h = np.asarray(mesh.fref)[tm]
+    etag_h = np.asarray(mesh.etag)[tm]
     part = np.asarray(part, np.int32)
     assert part.shape[0] == len(tet)
 
@@ -96,25 +108,45 @@ def split_to_shards(mesh: Mesh, met, part: np.ndarray, nparts: int,
         # carry original tags
         svtag = np.zeros(capP, np.uint32)
         svtag[: len(gids)] = vtag[gids]
-        # freeze interface: vertices
+        # freeze interface: vertices.  MG_NOSURF marks REQ as OURS — a
+        # vertex the user already required must NOT carry NOSURF, or the
+        # merge would strip the user's REQ along with the freeze
+        # (tag_pmmg.c NOSURF semantics: "REQ set by us, can be relaxed")
         on_ifc = ifc_vert[gids]
+        user_req_v = (svtag[: len(gids)] & MG_REQ) != 0
         svtag[: len(gids)][on_ifc] |= PARBDY_TAGS
+        svtag[: len(gids)][on_ifc & user_req_v] &= ~np.uint32(MG_NOSURF)
         # PARBDYBDY: interface vertex that is also true boundary
         true_bdy = (vtag[gids] & MG_BDY) != 0
         svtag[: len(gids)][on_ifc & true_bdy] |= MG_PARBDYBDY
-        # faces + edges of interface
+        # faces + edges: carry the global tags/refs, then freeze interface
         sftag = np.zeros((capT, 4), np.uint32)
         setag = np.zeros((capT, 6), np.uint32)
+        sfref = np.zeros((capT, 4), np.int32)
+        sftag[: len(ltet)] = ftag_h[tsel]
+        setag[: len(ltet)] = etag_h[tsel]
+        sfref[: len(ltet)] = fref_h[tsel]
         lf_ifc = face_is_ifc[tsel]                       # [nt,4]
+        user_req_f = (sftag[: len(ltet)] & MG_REQ) != 0
         sftag[: len(ltet)][lf_ifc] |= PARBDY_TAGS
+        sftag[: len(ltet)][lf_ifc & user_req_f] &= ~np.uint32(MG_NOSURF)
+        e_ifc_m = np.zeros((len(ltet), 6), bool)
         for f in range(4):
             for e in FACE_EDGES[f]:
-                setag[: len(ltet), e] |= np.where(
-                    lf_ifc[:, f], np.uint32(PARBDY_TAGS), np.uint32(0))
+                e_ifc_m[:, e] |= lf_ifc[:, f]
+        # an interface edge that was ALSO true boundary keeps that fact
+        # through the freeze via MG_PARBDYBDY (tag_pmmg.c PARBDYBDY
+        # role); a user-required edge keeps REQ by NOT carrying NOSURF
+        pre_bdy_e = (setag[: len(ltet)] & MG_BDY) != 0
+        user_req_e = (setag[: len(ltet)] & MG_REQ) != 0
+        setag[: len(ltet)][e_ifc_m] |= PARBDY_TAGS
+        setag[: len(ltet)][e_ifc_m & pre_bdy_e] |= MG_PARBDYBDY
+        setag[: len(ltet)][e_ifc_m & user_req_e] &= ~np.uint32(MG_NOSURF)
         sm = dataclasses.replace(
             sm, vtag=jnp.asarray(svtag),
             ftag=jnp.maximum(sm.ftag, jnp.asarray(sftag)),
-            etag=jnp.maximum(sm.etag, jnp.asarray(setag)))
+            etag=jnp.maximum(sm.etag, jnp.asarray(setag)),
+            fref=jnp.asarray(sfref))
         sm = boundary_edge_tags(build_adjacency(sm))
         shards_m.append(sm)
         lmet = np.zeros((capP,) + methost.shape[1:], methost.dtype)
@@ -123,6 +155,8 @@ def split_to_shards(mesh: Mesh, met, part: np.ndarray, nparts: int,
 
     stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *shards_m)
     met_stacked = jnp.stack(shards_met)
+    if return_l2g:
+        return stacked, met_stacked, [loc[0] for loc in locals_]
     return stacked, met_stacked
 
 
@@ -138,16 +172,21 @@ def merge_shards(shards: Mesh, mets=None, return_part: bool = False):
     nsh = shards.vert.shape[0]
     all_v, all_tag, all_ref, all_met = [], [], [], []
     all_t, all_tref, all_src = [], [], []
+    all_ft, all_fr, all_et = [], [], []
     offsets = []
     off = 0
     for s in range(nsh):
         one = jax.tree.map(lambda x: x[s], shards)
         vert, tet, vref, tref, vtag = mesh_to_host(one)
+        tm = np.asarray(one.tmask)
         all_v.append(vert)
         all_tag.append(vtag)
         all_ref.append(vref)
         all_t.append(tet + off)
         all_tref.append(tref)
+        all_ft.append(np.asarray(one.ftag)[tm])
+        all_fr.append(np.asarray(one.fref)[tm])
+        all_et.append(np.asarray(one.etag)[tm])
         all_src.append(np.full(len(tet), s, np.int32))
         if mets is not None:
             mh = np.asarray(mets[s])[np.asarray(one.vmask)]
@@ -159,6 +198,26 @@ def merge_shards(shards: Mesh, mets=None, return_part: bool = False):
     vref = np.concatenate(all_ref)
     tet = np.concatenate(all_t)
     tref = np.concatenate(all_tref)
+    # face/edge tags travel back with the tets; interface faces become
+    # interior (drop the freeze + BDY bits); interface edges keep their
+    # true-boundary nature via PARBDYBDY and USER-required status via the
+    # absence of MG_NOSURF (REQ without NOSURF was set by the caller, not
+    # by the freeze — tag_pmmg.c NOSURF semantics)
+    ftag_m = np.concatenate(all_ft)
+    fref_m = np.concatenate(all_fr)
+    etag_m = np.concatenate(all_et)
+    f_ifc = (ftag_m & MG_PARBDY) != 0
+    f_user = f_ifc & ((ftag_m & MG_NOSURF) == 0) & \
+        ((ftag_m & MG_REQ) != 0)
+    ftag_m[f_ifc] &= ~np.uint32(PARBDY_TAGS)
+    ftag_m[f_user] |= MG_REQ
+    e_ifc = (etag_m & MG_PARBDY) != 0
+    e_truebdy = (etag_m & MG_PARBDYBDY) != 0
+    e_user = e_ifc & ((etag_m & MG_NOSURF) == 0) & \
+        ((etag_m & MG_REQ) != 0)
+    etag_m[e_ifc] &= ~np.uint32(PARBDY_TAGS | MG_PARBDYBDY)
+    etag_m[e_ifc & e_truebdy] |= MG_BDY
+    etag_m[e_user] |= MG_REQ
 
     # dedup PARBDY vertices by coordinate bytes
     is_ifc = (vtag & MG_PARBDY) != 0
@@ -179,13 +238,25 @@ def merge_shards(shards: Mesh, mets=None, return_part: bool = False):
     vtag2 = vtag[keep].copy()
     was_truebdy = (vtag2 & MG_PARBDYBDY) != 0
     was_parbdy = (vtag2 & MG_PARBDY) != 0
+    was_user_req = was_parbdy & ((vtag2 & MG_NOSURF) == 0) & \
+        ((vtag2 & MG_REQ) != 0)
     vtag2 &= ~np.uint32(PARBDY_TAGS | MG_PARBDYBDY)
     vtag2[was_truebdy] |= MG_BDY
     vtag2[was_parbdy & ~was_truebdy] &= ~np.uint32(MG_BDY)
+    vtag2[was_user_req] |= MG_REQ
     m = make_mesh(vert[keep], tet, vref=vref[keep], tref=tref)
     vtag_full = np.zeros(m.capP, np.uint32)
     vtag_full[: len(vtag2)] = vtag2
-    m = dataclasses.replace(m, vtag=jnp.asarray(vtag_full))
+    ftag_full = np.zeros((m.capT, 4), np.uint32)
+    ftag_full[: len(ftag_m)] = ftag_m
+    fref_full = np.zeros((m.capT, 4), np.int32)
+    fref_full[: len(fref_m)] = fref_m
+    etag_full = np.zeros((m.capT, 6), np.uint32)
+    etag_full[: len(etag_m)] = etag_m
+    m = dataclasses.replace(m, vtag=jnp.asarray(vtag_full),
+                            ftag=jnp.asarray(ftag_full),
+                            fref=jnp.asarray(fref_full),
+                            etag=jnp.asarray(etag_full))
     m = boundary_edge_tags(build_adjacency(m))
     out_met = None
     if mets is not None:

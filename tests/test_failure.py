@@ -1,0 +1,74 @@
+"""Graded failure handling: PMMG_LOWFAILURE + saved conforming mesh.
+
+Reference contract (failed_handling, libparmmg1.c:974-1011): when the
+remesh loop cannot complete (here: shard capacity exhausted after the
+regrow cap), the library returns PMMG_LOWFAILURE and the caller can still
+retrieve and save a CONFORMING mesh."""
+import numpy as np
+import jax.numpy as jnp
+
+from parmmg_tpu.api.parmesh import ParMesh
+from parmmg_tpu.core import constants as C
+from parmmg_tpu.utils.fixtures import cube_mesh
+
+
+def _staged_pm(n_devices):
+    vert, tet = cube_mesh(3)
+    pm = ParMesh()
+    pm.set_mesh_size(len(vert), len(tet))
+    pm.set_vertices(vert, np.zeros(len(vert), np.int32))
+    pm.set_tetrahedra(tet + 1, np.ones(len(tet), np.int32))
+    pm.info.hsiz = 0.12
+    pm.info.niter = 1
+    pm.info.imprim = -1
+    pm.info.n_devices = n_devices
+    return pm
+
+
+def test_shard_overflow_degrades_to_lowfailure(monkeypatch):
+    from parmmg_tpu.parallel import dist, distribute
+
+    # force the first overflow to be terminal: with the regrow cap at -1
+    # the run cannot regrow, and a 1.02x capacity multiplier guarantees
+    # the refinement overflows the shards immediately
+    monkeypatch.setattr(dist, "MAX_SHARD_REGROWS", -1)
+    orig = distribute.split_to_shards
+
+    def tight_split(mesh, met, part, nparts, cap_mult=3.0, **kw):
+        return orig(mesh, met, part, nparts, cap_mult=1.02, **kw)
+
+    monkeypatch.setattr(distribute, "split_to_shards", tight_split)
+
+    pm = _staged_pm(n_devices=2)
+    ret = pm.run()
+    assert ret == C.PMMG_LOWFAILURE
+
+    # the staged output is a valid conforming mesh: positive volumes
+    # summing to the cube, retrievable through the normal getters
+    npts, ntet = pm.get_mesh_size()[:2]
+    assert ntet > 0
+    from parmmg_tpu.core.mesh import tet_volumes
+    from parmmg_tpu.ops.adjacency import build_adjacency, check_adjacency
+    m = build_adjacency(pm._out)
+    assert check_adjacency(m) == {"asymmetric": 0, "face_mismatch": 0}
+    vols = np.asarray(tet_volumes(m))[np.asarray(m.tmask)]
+    assert (vols > 0).all()
+    assert np.isclose(vols.sum(), 1.0, rtol=1e-5)
+
+    # and it round-trips through Medit output (the "saveable" half)
+    import tempfile, os
+    from parmmg_tpu.io.medit import MeditMesh, write_mesh, read_mesh
+    with tempfile.TemporaryDirectory() as d:
+        path = os.path.join(d, "out.mesh")
+        mm = MeditMesh()
+        mm.vert, mm.vref = pm.get_vertices()
+        mm.tetra, mm.tref = pm.get_tetrahedra()
+        mm.tetra = np.asarray(mm.tetra) - 1
+        write_mesh(path, mm)
+        back = read_mesh(path)
+        assert len(back.tetra) == ntet
+
+
+def test_success_path_still_returns_success():
+    pm = _staged_pm(n_devices=1)
+    assert pm.run() == C.PMMG_SUCCESS
