@@ -48,6 +48,12 @@ def build_metric(mesh: Mesh, met, info):
     elif met is None or info.optim or info.optimLES:
         met = metric_optim(mesh)
     met = clamp_metric(met, hmin, hmax)
+    # surface-approximation size bound (Mmg defsiz -hausd route): chord
+    # deviation under hausd needs h <= sqrt(8*hausd/kappa) on curved
+    # boundary regions
+    if info.hausd > 0:
+        from .ops.metric import hausd_metric_bound
+        met = hausd_metric_bound(mesh, met, info.hausd, hmin)
     # local bounds BEFORE gradation (Mmg defsiz-then-gradsiz order) so the
     # size jump at a ref-patch boundary is smoothed by -hgrad; re-applied
     # after, since gradation only propagates smaller sizes and may pull a
@@ -149,9 +155,22 @@ def parmmg_run(pm) -> tuple[Mesh, object, AdaptStats]:
 
     stats = AdaptStats()
     angedg = info.angedg()
+    # surface-approximation tolerance: global -hausd, tightened by any
+    # local-parameter hausd (per-reference hausd applies conservatively
+    # as the global minimum until per-entity hausd fields land)
+    hausd = info.hausd
+    for _typ, _ref, _hm, _hx, _hd in info.local_params:
+        if _hd and _hd > 0:
+            hausd = min(hausd, _hd)
     if info.n_devices <= 1:
+        import jax
+        import jax.numpy as jnp
         niter = max(1, info.niter)
         for it in range(niter):
+            # the jitted cycles DONATE their input buffers, so the
+            # pre-iteration binding would be dead after a failure; keep a
+            # device-side copy for the degrade path (HBM-to-HBM, cheap)
+            backup = (jax.tree.map(jnp.copy, mesh), jnp.copy(met))
             try:
                 with tim(f"adaptation"):
                     mesh, met, st = adapt_mesh(
@@ -159,17 +178,19 @@ def parmmg_run(pm) -> tuple[Mesh, object, AdaptStats]:
                         verbose=3 if info.imprim >= C.PMMG_VERB_ITWAVES
                         else 0,
                         noinsert=info.noinsert, noswap=info.noswap,
-                        nomove=info.nomove, angedg=angedg)
+                        nomove=info.nomove, angedg=angedg, hausd=hausd)
             except MemoryError:
-                # capacity exhausted mid-iteration: the pre-iteration
-                # mesh binding is still conforming — degrade, don't die
-                # (failed_handling, libparmmg1.c:974-1011)
+                # capacity exhausted mid-iteration: restore the backup
+                # (conforming) and degrade, don't die (failed_handling,
+                # libparmmg1.c:974-1011)
+                mesh, met = backup
                 stats.status = C.PMMG_LOWFAILURE
                 break
             except Exception as e:  # device OOM comes as XlaRuntimeError
                 if "RESOURCE_EXHAUSTED" not in str(e) and \
                         "Out of memory" not in str(e):
                     raise
+                mesh, met = backup
                 stats.status = C.PMMG_LOWFAILURE
                 break
             stats += st
@@ -193,7 +214,7 @@ def parmmg_run(pm) -> tuple[Mesh, object, AdaptStats]:
                         else 0,
                         stats=stats, noinsert=info.noinsert,
                         noswap=info.noswap, nomove=info.nomove,
-                        angedg=angedg)
+                        angedg=angedg, hausd=hausd)
             except ShardOverflowError as e:
                 # degrade to LOWFAILURE with the conforming merged state
                 # (failed_handling, libparmmg1.c:974-1011)
@@ -226,7 +247,7 @@ def parmmg_run(pm) -> tuple[Mesh, object, AdaptStats]:
                         mesh, met, jnp.asarray(1000 + w, jnp.int32),
                         do_collapse=not info.noinsert,
                         do_swap=not info.noswap,
-                        do_smooth=not info.nomove)
+                        do_smooth=not info.nomove, hausd=hausd)
                     pc = np.asarray(counts)
                     stats.ncollapse += int(pc[0])
                     stats.nswap += int(pc[1])
@@ -236,6 +257,20 @@ def parmmg_run(pm) -> tuple[Mesh, object, AdaptStats]:
                     if int(pc[0]) == 0 and int(pc[1]) == 0:
                         break
         pm._out_part = part          # reused by distributed output
+
+    # sequential last-resort repair: tangled sliver clusters (stacked
+    # near-flat tets, typically born at former frozen interfaces) veto
+    # every BATCHED fix — each parallel op inverts a neighbor — while the
+    # reference's sequential remesher resolves them one op at a time;
+    # ops/repair.py reproduces that freedom for the (tiny) tail only
+    if not (info.noinsert and info.noswap and info.nomove):
+        from .ops.repair import repair_mesh
+        with tim("sequential repair"):
+            mesh, nrep = repair_mesh(
+                mesh, met, allow_collapse=not info.noinsert,
+                allow_swap=not info.noswap, allow_move=not info.nomove)
+            if nrep and info.imprim >= C.PMMG_VERB_STEPS:
+                print(f"  sequential repair: {nrep} cluster ops")
 
     # interpolate user fields old mesh -> new mesh
     if bg_fields:

@@ -59,7 +59,8 @@ class AdaptStats:
 def adapt_cycle_impl(mesh: Mesh, met: jax.Array, wave: jax.Array,
                      do_swap: bool = True, do_smooth: bool = True,
                      smooth_waves: int = 1, do_insert: bool = True,
-                     final_rebuild: bool = True):
+                     final_rebuild: bool = True,
+                     hausd: float | None = None):
     """One adaptation cycle: split -> collapse -> [swap] -> [smooth].
 
     Pure jittable function (jitted wrapper below) — also the compile-check
@@ -83,11 +84,11 @@ def adapt_cycle_impl(mesh: Mesh, met: jax.Array, wave: jax.Array,
     """
     from .adjacency import boundary_edge_tags
     if do_insert:
-        res = split_wave(mesh, met)
+        res = split_wave(mesh, met, hausd=hausd)
         mesh, met = res.mesh, res.met
         nsplit, overflow = res.nsplit, res.overflow
 
-        col = collapse_wave(mesh, met)
+        col = collapse_wave(mesh, met, hausd=hausd)
         # collapse rewires the surface (dying tets' face tags transfer to
         # the surviving neighbors); re-propagate MG_BDY from faces to
         # their edges and vertices so later splits/smooth treat the new
@@ -103,7 +104,7 @@ def adapt_cycle_impl(mesh: Mesh, met: jax.Array, wave: jax.Array,
 
     nswap = jnp.zeros((), jnp.int32)
     if do_swap:
-        sew = swap_edges_wave(mesh, met)        # 3-2 + 2-2, one edge table
+        sew = swap_edges_wave(mesh, met, hausd=hausd)  # 3-2 + 2-2
         mesh = build_adjacency(sew.mesh)        # consumed by swap23
         s23 = swap23_wave(mesh, met)
         mesh = s23.mesh
@@ -126,13 +127,15 @@ def adapt_cycle_impl(mesh: Mesh, met: jax.Array, wave: jax.Array,
 
 
 adapt_cycle = partial(jax.jit, static_argnames=(
-    "do_swap", "do_smooth", "smooth_waves", "do_insert", "final_rebuild"),
+    "do_swap", "do_smooth", "smooth_waves", "do_insert", "final_rebuild",
+    "hausd"),
     donate_argnums=(0, 1))(adapt_cycle_impl)
 
 
 def adapt_cycles_fused_impl(mesh: Mesh, met: jax.Array, wave0: jax.Array,
                             n_cycles: int = 3, swap_every: int = 3,
-                            swap_offset: int = 0):
+                            swap_offset: int = 0,
+                            hausd: float | None = None):
     """``n_cycles`` adaptation cycles in ONE jitted program.
 
     On a remote-attached TPU every dispatch pays a transport round trip
@@ -155,19 +158,20 @@ def adapt_cycles_fused_impl(mesh: Mesh, met: jax.Array, wave0: jax.Array,
         do_swap = ((c + swap_offset) % swap_every == swap_every - 1)
         mesh, met, counts = adapt_cycle_impl(
             mesh, met, wave0 + c, do_swap=do_swap,
-            final_rebuild=(c == n_cycles - 1))
+            final_rebuild=(c == n_cycles - 1), hausd=hausd)
         counts_all.append(counts)
     return mesh, met, jnp.stack(counts_all)
 
 
 adapt_cycles_fused = partial(jax.jit, static_argnames=(
-    "n_cycles", "swap_every", "swap_offset"),
+    "n_cycles", "swap_every", "swap_offset", "hausd"),
     donate_argnums=(0, 1))(adapt_cycles_fused_impl)
 
 
 def sliver_polish_impl(mesh: Mesh, met: jax.Array, wave: jax.Array,
                        sliver_q: float = 0.2, do_collapse: bool = True,
-                       do_swap: bool = True, do_smooth: bool = True):
+                       do_swap: bool = True, do_smooth: bool = True,
+                       hausd: float | None = None):
     """Bad-element optimization pass (MMG3D_opttyp analogue): quality-
     targeted collapses on tets below ``sliver_q``, then swaps and a
     smoothing wave.  Run after the sizing loop converges — length-driven
@@ -181,11 +185,11 @@ def sliver_polish_impl(mesh: Mesh, met: jax.Array, wave: jax.Array,
     nswap = jnp.zeros((), jnp.int32)
     nmoved = jnp.zeros((), jnp.int32)
     if do_collapse:
-        col = collapse_wave(mesh, met, sliver_q=sliver_q)
+        col = collapse_wave(mesh, met, sliver_q=sliver_q, hausd=hausd)
         mesh = boundary_edge_tags(col.mesh)
         ncol = col.ncollapse
     if do_swap:
-        sew = swap_edges_wave(mesh, met)        # 3-2 + 2-2, one edge table
+        sew = swap_edges_wave(mesh, met, hausd=hausd)  # 3-2 + 2-2
         mesh = build_adjacency(sew.mesh)        # consumed by swap23
         s23 = swap23_wave(mesh, met)
         mesh = s23.mesh
@@ -201,7 +205,7 @@ def sliver_polish_impl(mesh: Mesh, met: jax.Array, wave: jax.Array,
 
 
 sliver_polish = partial(jax.jit, static_argnames=(
-    "sliver_q", "do_collapse", "do_swap", "do_smooth"),
+    "sliver_q", "do_collapse", "do_swap", "do_smooth", "hausd"),
     donate_argnums=(0,))(sliver_polish_impl)
 
 
@@ -218,7 +222,8 @@ def adapt_mesh(mesh: Mesh, met: jax.Array, max_cycles: int = 50,
                verbose: int = 0, headroom: float = 0.85,
                swap_every: int = 3, noinsert: bool = False,
                noswap: bool = False, nomove: bool = False,
-               angedg: float | None = None) -> tuple:
+               angedg: float | None = None,
+               hausd: float | None = None) -> tuple:
     """Host driver: run cycles until no topological change, manage capacity.
 
     Swap waves cost about as much as split+collapse+smooth combined (they
@@ -249,7 +254,7 @@ def adapt_mesh(mesh: Mesh, met: jax.Array, max_cycles: int = 50,
             and not noswap
         mesh, met, counts = adapt_cycle(
             mesh, met, jnp.asarray(cycle, jnp.int32), do_swap=do_swap,
-            do_smooth=not nomove, do_insert=not noinsert)
+            do_smooth=not nomove, do_insert=not noinsert, hausd=hausd)
         ns, nc, nw, nm, ovf, _ = (int(v) for v in np.asarray(counts))
         stats.nsplit += ns
         stats.ncollapse += nc
@@ -281,7 +286,7 @@ def adapt_mesh(mesh: Mesh, met: jax.Array, max_cycles: int = 50,
                                      jnp.asarray(1000 + w, jnp.int32),
                                      do_collapse=not noinsert,
                                      do_swap=not noswap,
-                                     do_smooth=not nomove)
+                                     do_smooth=not nomove, hausd=hausd)
         nc, nw, nm, _ = (int(v) for v in np.asarray(counts))
         stats.ncollapse += nc
         stats.nswap += nw

@@ -46,6 +46,33 @@ class AnalysisResult(NamedTuple):
     vnormal: jax.Array    # [capP, 3] unit vertex normals (0 off-surface)
 
 
+def boundary_vertex_normals(mesh: Mesh) -> jax.Array:
+    """[capP,3] unit outward vertex normals from true-boundary faces.
+
+    Area-weighted average over incident MG_BDY (non-PARBDY) faces via ONE
+    concatenated scatter — cheap enough to run inside the waves (the
+    hausd-driven surface approximation needs endpoint normals per split/
+    collapse candidate; Mmg instead stores xPoint normals, norver).
+    Zeros off-surface.
+    """
+    import jax.numpy as jnp
+    from ..core.constants import IDIR, MG_BDY, MG_PARBDY, EPSD
+    capP = mesh.capP
+    idir = jnp.asarray(IDIR)
+    isb = ((mesh.ftag & MG_BDY) != 0) & ((mesh.ftag & MG_PARBDY) == 0) & \
+        mesh.tmask[:, None]
+    fv = mesh.tet[:, idir]                                 # [T,4,3]
+    fp = mesh.vert[fv]                                     # [T,4,3,3]
+    fn = jnp.cross(fp[:, :, 1] - fp[:, :, 0], fp[:, :, 2] - fp[:, :, 0])
+    idx12 = jnp.concatenate(
+        [jnp.where(isb[:, f], fv[:, f, k], capP)
+         for f in range(4) for k in range(3)])
+    pay12 = jnp.concatenate([fn[:, f] for f in range(4) for _ in range(3)])
+    nacc = jnp.zeros((capP + 1, 3), mesh.vert.dtype).at[idx12].add(
+        pay12, mode="drop")[:capP]
+    return nacc / (jnp.linalg.norm(nacc, axis=-1, keepdims=True) + EPSD)
+
+
 def face_normals(mesh: Mesh) -> jax.Array:
     """[capT, 4, 3] outward (non-unit) normals of each tet face.
 

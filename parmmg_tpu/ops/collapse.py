@@ -63,7 +63,8 @@ def _removable(vtag, other_vtag, edge_tag):
 
 def collapse_wave(mesh: Mesh, met: jax.Array, lmin: float = LSHRT,
                   lmax: float = LLONG,
-                  sliver_q: float | None = None) -> CollapseResult:
+                  sliver_q: float | None = None,
+                  hausd: float | None = None) -> CollapseResult:
     """One independent-set collapse wave.
 
     Normal mode: contract edges shorter than ``lmin`` (Mmg's colver over
@@ -94,6 +95,20 @@ def collapse_wave(mesh: Mesh, met: jax.Array, lmin: float = LSHRT,
             jnp.repeat(bad_tet, 6), mode="drop")
         # don't lengthen already-long edges by contracting into them
         short = et.emask & bad_edge & ~frozen_edge & (lens < lmax)
+
+    if hausd is not None:
+        # surface-approximation veto (Mmg -hausd): removing a boundary
+        # vertex flattens the local surface by ~ the edge's Bezier
+        # deviation |t_a - t_b|/8 — refuse when that exceeds hausd
+        from .analysis import boundary_vertex_normals
+        vn = boundary_vertex_normals(mesh)
+        on_bdy = (et.etag & MG_BDY) != 0
+        d = mesh.vert[vb] - mesh.vert[va]
+        na, nb = vn[va], vn[vb]
+        t_a = d - na * jnp.sum(na * d, -1, keepdims=True)
+        t_b = d - nb * jnp.sum(nb * d, -1, keepdims=True)
+        dev = jnp.linalg.norm(0.125 * (t_a - t_b), axis=-1)
+        short = short & ~(on_bdy & (dev > hausd))
 
     ta, tb = mesh.vtag[va], mesh.vtag[vb]
     rem_b = _removable(tb, ta, et.etag)      # can delete b (keep a)

@@ -43,6 +43,57 @@ def metric_optim(mesh: Mesh) -> jax.Array:
     return jnp.where(mesh.vmask, h, 1.0)
 
 
+def hausd_metric_bound(mesh: Mesh, met, hausd: float, hmin: float):
+    """Bound boundary sizes by the surface approximation tolerance.
+
+    The Mmg ``defsiz`` route for -hausd: a chord of length h on a surface
+    of curvature kappa deviates by ~ h^2 * kappa / 8, so keeping the
+    deviation under hausd requires h <= sqrt(8 * hausd / kappa).  Vertex
+    curvature is estimated from the spread of boundary-vertex normals
+    over incident regular boundary edges (ridge/corner endpoints are
+    excluded — their normals are multivalued and ridges are preserved by
+    tags, not size).  Iso metric only; host-side, once per run.
+    """
+    import numpy as np
+    from ..core.constants import (
+        IDIR, MG_BDY, MG_CRN, MG_GEO, MG_NOM, MG_PARBDY, MG_REQ)
+    from .analysis import boundary_vertex_normals
+    if met.ndim != 1:
+        return met                           # aniso: not yet bounded
+    vn = np.asarray(boundary_vertex_normals(mesh))
+    tm = np.asarray(mesh.tmask)
+    tet = np.asarray(mesh.tet)[tm]
+    ftag = np.asarray(mesh.ftag)[tm]
+    vtag = np.asarray(mesh.vtag)
+    capP = mesh.capP
+    tris = []
+    for f in range(4):
+        sel = (ftag[:, f] & MG_BDY) != 0
+        if sel.any():
+            tris.append(tet[sel][:, IDIR[f]])
+    if not tris:
+        return met
+    tris = np.concatenate(tris)
+    ed = np.concatenate([tris[:, [0, 1]], tris[:, [1, 2]],
+                         tris[:, [0, 2]]])
+    sing = MG_GEO | MG_CRN | MG_REQ | MG_PARBDY | MG_NOM
+    ok = ((vtag[ed[:, 0]] & sing) == 0) & ((vtag[ed[:, 1]] & sing) == 0)
+    ed = ed[ok]
+    if not len(ed):
+        return met
+    vh = np.asarray(mesh.vert)
+    dn = np.linalg.norm(vn[ed[:, 0]] - vn[ed[:, 1]], axis=1)
+    dl = np.linalg.norm(vh[ed[:, 0]] - vh[ed[:, 1]], axis=1)
+    kappa = dn / np.maximum(dl, 1e-30)
+    kv = np.zeros(capP)
+    np.maximum.at(kv, ed[:, 0], kappa)
+    np.maximum.at(kv, ed[:, 1], kappa)
+    with np.errstate(divide="ignore"):
+        h_geom = np.sqrt(8.0 * hausd / np.maximum(kv, 1e-30))
+    h_geom = np.maximum(np.where(kv > 1e-12, h_geom, np.inf), hmin)
+    return jnp.minimum(met, jnp.asarray(h_geom, met.dtype))
+
+
 def clamp_metric(met: jax.Array, hmin: float, hmax: float) -> jax.Array:
     if met.ndim == 1:
         return jnp.clip(met, hmin, hmax)

@@ -51,8 +51,23 @@ def _interp_met_mid(met, va, vb):
 
 
 def split_wave(mesh: Mesh, met: jax.Array, lmax: float = LLONG,
-               frozen_vtag: int = MG_REQ | MG_PARBDY) -> SplitResult:
-    """One independent-set split wave. Jittable; static shapes throughout."""
+               frozen_vtag: int = MG_REQ | MG_PARBDY,
+               hausd: float | None = None) -> SplitResult:
+    """One independent-set split wave. Jittable; static shapes throughout.
+
+    ``hausd`` enables the PLACEMENT half of surface-approximation
+    control (Mmg -hausd): refinement pressure itself comes from the
+    metric (driver.build_metric folds sqrt(8*hausd/kappa) into boundary
+    sizes via ops.metric.hausd_metric_bound — the defsiz route), while
+    here regular boundary midpoints are LIFTED onto the cubic Bezier
+    curve
+    through the endpoints+normals (MMG5_BezierRegular flavor) — the
+    deviation estimate is |t_a - t_b|/8 with t_* the edge vector
+    projected on each endpoint's tangent plane; the midpoint correction
+    is (t_a - t_b)/8, exact to O(h^4) on a sphere.  Ridge/corner/required
+    endpoints are excluded (their normals are multivalued — the flat
+    cube workloads are bit-for-bit unchanged).
+    """
     capT, capP = mesh.capT, mesh.capP
     et = unique_edges(mesh)
     lens = edge_lengths(mesh, et, met)
@@ -62,6 +77,24 @@ def split_wave(mesh: Mesh, met: jax.Array, lmax: float = LLONG,
     vb = jnp.clip(et.ev[:, 1], 0, capP - 1)
     frozen_edge = (et.etag & (MG_REQ | MG_PARBDY)) != 0
     cand = et.emask & (lens > lmax) & ~frozen_edge
+    lift_corr = None
+    if hausd is not None:
+        from .analysis import boundary_vertex_normals
+        from ..core.constants import MG_CRN, MG_NOM
+        vn = boundary_vertex_normals(mesh)
+        sing = MG_GEO | MG_CRN | MG_REQ | MG_PARBDY | MG_NOM | MG_REF
+        regular = ((et.etag & MG_BDY) != 0) & \
+            ((et.etag & (MG_GEO | MG_REQ | MG_PARBDY | MG_REF)) == 0) & \
+            ((mesh.vtag[va] & sing) == 0) & ((mesh.vtag[vb] & sing) == 0)
+        d = mesh.vert[vb] - mesh.vert[va]
+        na, nb = vn[va], vn[vb]
+        t_a = d - na * jnp.sum(na * d, -1, keepdims=True)
+        t_b = d - nb * jnp.sum(nb * d, -1, keepdims=True)
+        corr = 0.125 * (t_a - t_b)                     # Bezier mid offset
+        # refinement pressure comes from the METRIC (hausd_metric_bound
+        # folds sqrt(8*hausd/kappa) into boundary sizes, the Mmg defsiz
+        # route); here hausd only drives point PLACEMENT
+        lift_corr = jnp.where(regular[:, None], corr, 0.0)
     s, t = claim_channels(lens, cand)                 # sort-free priority
 
     # --- nomination: each tet picks its (s,t)-max candidate edge ---------
@@ -85,6 +118,8 @@ def split_wave(mesh: Mesh, met: jax.Array, lmax: float = LLONG,
     i_n = _IARE_J[loc_n, 0]
     j_n = _IARE_J[loc_n, 1]
     mid_n = 0.5 * (mesh.vert[va[e_n]] + mesh.vert[vb[e_n]])
+    if lift_corr is not None:
+        mid_n = mid_n + lift_corr[e_n]
     pts = mesh.vert[mesh.tet]                             # [T,4,3]
     q1 = quality_from_points(pts.at[ar0, j_n].set(mid_n))
     q2 = quality_from_points(pts.at[ar0, i_n].set(mid_n))
@@ -121,6 +156,8 @@ def split_wave(mesh: Mesh, met: jax.Array, lmax: float = LLONG,
     # midpoint coordinates / refs / tags
     pa, pb = mesh.vert[va], mesh.vert[vb]
     mid = 0.5 * (pa + pb)
+    if lift_corr is not None:
+        mid = mid + lift_corr                 # onto the Bezier surface
     upd = win
     vert = _scatter_rows(mesh.vert, mid_id, mid, upd)
     vmask = _scatter_rows(mesh.vmask, mid_id,

@@ -49,7 +49,8 @@ def _met6(met):
 
 def swap_edges_wave(mesh: Mesh, met: jax.Array, enable32: bool = True,
                     enable22: bool = True,
-                    flat_tol: float = 1e-5) -> SwapResult:
+                    flat_tol: float = 1e-5,
+                    hausd: float | None = None) -> SwapResult:
     """Combined edge-swap wave: 3-2 interior + 2-2 boundary, ONE pass.
 
     Both swaps share the same cavity shape — edge (a,b) is replaced by two
@@ -197,14 +198,24 @@ def swap_edges_wave(mesh: Mesh, met: jax.Array, enable32: bool = True,
                                   (pa_, pb_, pc_, pp_, pq_)]), axis=0)
         off_plane = jnp.abs(jnp.sum(n_abp * (pq_ - pa_), -1)) / nn
         noise_op = 32.0 * eps_c * cmax * hloc * hloc / nn
-        base22 = base22 & (off_plane <= flat_tol * hloc + noise_op)
+        # hausd relaxes the surface-exactness requirement to the Mmg
+        # approximation tolerance: the flip changes the surface by at
+        # most the quad's out-of-plane deviation
+        tol_op = flat_tol * hloc + noise_op
+        if hausd is not None:
+            tol_op = jnp.maximum(tol_op, hausd)
+        base22 = base22 & (off_plane <= tol_op)
         area = lambda nv: 0.5 * jnp.sqrt(jnp.sum(nv * nv, -1))
         a_old = area(n_abp) + area(n_abq)
         a_new = area(jnp.cross(pq_ - pp_, pa_ - pp_)) + \
             area(jnp.cross(pq_ - pp_, pb_ - pp_))
         noise_ar = 32.0 * eps_c * cmax * hloc
-        base22 = base22 & (jnp.abs(a_old - a_new) <=
-                           1e-5 * (a_old + EPSD) + noise_ar)
+        tol_ar = 1e-5 * (a_old + EPSD) + noise_ar
+        if hausd is not None:
+            # area may legitimately change by ~ hausd * perimeter when
+            # the quad is curved within tolerance
+            tol_ar = jnp.maximum(tol_ar, hausd * hloc)
+        base22 = base22 & (jnp.abs(a_old - a_new) <= tol_ar)
         # the flipped diagonal must not already exist (duplicate edge =>
         # non-manifold surface).  Packed int32 binary search when ids fit
         # (edges.PACK_LIMIT); sort-join fallback otherwise (no x64).
@@ -384,11 +395,11 @@ def swap32_wave(mesh: Mesh, met: jax.Array) -> SwapResult:
     return swap_edges_wave(mesh, met, enable32=True, enable22=False)
 
 
-def swap22_wave(mesh: Mesh, met: jax.Array,
-                flat_tol: float = 1e-5) -> SwapResult:
+def swap22_wave(mesh: Mesh, met: jax.Array, flat_tol: float = 1e-5,
+                hausd: float | None = None) -> SwapResult:
     """2-2 boundary edge swap only (see swap_edges_wave)."""
     return swap_edges_wave(mesh, met, enable32=False, enable22=True,
-                           flat_tol=flat_tol)
+                           flat_tol=flat_tol, hausd=hausd)
 
 
 def swap23_wave(mesh: Mesh, met: jax.Array) -> SwapResult:

@@ -70,7 +70,8 @@ def shard_stacked(stacked, dmesh: DeviceMesh):
 
 
 def dist_adapt_cycle(dmesh: DeviceMesh, do_swap: bool = True,
-                     do_smooth: bool = True, do_insert: bool = True):
+                     do_smooth: bool = True, do_insert: bool = True,
+                     hausd: float | None = None):
     """Build the jitted SPMD adapt step for a given device mesh.
 
     The per-shard body is the same ``adapt_cycle_impl`` as the single-chip
@@ -90,7 +91,7 @@ def dist_adapt_cycle(dmesh: DeviceMesh, do_swap: bool = True,
         met = met_s[0]
         mesh, met, counts = adapt_cycle_impl(
             mesh, met, wave, do_swap=do_swap, do_smooth=do_smooth,
-            do_insert=do_insert, smooth_waves=2)
+            do_insert=do_insert, smooth_waves=2, hausd=hausd)
         ovf = jax.lax.pmax(counts[4], "shard")
         counts = jax.lax.psum(counts[:4], "shard")
         return _restack(mesh), met[None], counts, ovf
@@ -238,7 +239,8 @@ def distributed_adapt(mesh: Mesh, met, n_shards: int,
                       partitioner: str = "morton", verbose: int = 0,
                       part: np.ndarray | None = None, stats=None,
                       noinsert: bool = False, noswap: bool = False,
-                      nomove: bool = False, angedg: float | None = None):
+                      nomove: bool = False, angedg: float | None = None,
+                      hausd: float | None = None):
     """One outer remesh pass on n_shards devices (host driver).
 
     partition (metric-weighted, boundary-refined; or take the caller's
@@ -277,12 +279,12 @@ def distributed_adapt(mesh: Mesh, met, n_shards: int,
     cap_mult = 3.0
     step_full = dist_adapt_cycle(dmesh, do_swap=not noswap,
                                  do_smooth=not nomove,
-                                 do_insert=not noinsert)
+                                 do_insert=not noinsert, hausd=hausd)
     # with -noswap both flavors are the same program: don't compile the
     # multi-minute SPMD graph twice
     step_light = step_full if noswap else dist_adapt_cycle(
         dmesh, do_swap=False, do_smooth=not nomove,
-        do_insert=not noinsert)
+        do_insert=not noinsert, hausd=hausd)
     stacked = met_s = None
     comms = None
     vert_h, tet_h = vert, tet        # kept in sync with `mesh` (regrows)
@@ -334,20 +336,26 @@ def distributed_adapt(mesh: Mesh, met, n_shards: int,
             print(f"  dist cycle {c}: split {cs[0]} collapse {cs[1]} "
                   f"swap {cs[2]} move {cs[3]}")
         if int(ovf) != 0:
-            # shard capacity exhausted: merge, double headroom, re-split
-            # with the same partition and continue (the static-shape
-            # analogue of the reference's realloc/memory repartition,
-            # zaldy_pmmg.c:140-254).  Past the regrow cap, degrade to a
-            # LOWFAILURE with the conforming merged state instead of
-            # dying (failed_handling, libparmmg1.c:974-1011).
-            mesh, met, part = merge_shards(stacked, met_s,
-                                           return_part=True)
+            # shard capacity exhausted: grow the stacked buffers IN
+            # PLACE (slot ids preserved, comm tables stay valid — the
+            # realloc analogue, zaldy_pmmg.c:140-254, WITHOUT the
+            # whole-mesh merge->resplit the old path paid).  Past the
+            # regrow cap, degrade to a LOWFAILURE with the conforming
+            # merged state instead of dying (failed_handling,
+            # libparmmg1.c:974-1011).
             if regrows >= MAX_SHARD_REGROWS:
+                mesh, met, part = merge_shards(stacked, met_s,
+                                               return_part=True)
                 raise ShardOverflowError(mesh, met, part)
+            from .distribute import grow_shards
+            capP = stacked.vert.shape[1]
+            capT = stacked.tet.shape[1]
+            stacked, met_s = grow_shards(stacked, met_s,
+                                         2 * capP, 2 * capT)
+            stacked = shard_stacked(stacked, dmesh)
+            met_s = shard_stacked(met_s, dmesh)
             cap_mult *= 2.0
             regrows += 1
-            vert_h, tet_h, _, _, _ = mesh_to_host(mesh)
-            stacked = None
             continue
         c += 1
         if step is step_full and cs[0] == 0 and cs[1] == 0 and cs[2] == 0:

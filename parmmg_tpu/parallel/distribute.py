@@ -160,6 +160,161 @@ def split_to_shards(mesh: Mesh, met, part: np.ndarray, nparts: int,
     return stacked, met_stacked
 
 
+def _weld_close_pairs(vert, tet, vtag, met, tref, ftag, etag,
+                      tol_rel: float = 0.1):
+    """Contract near-coincident untagged vertex pairs, sequentially.
+
+    Independent refinement on the two sides of a frozen interface can
+    drop interior points a tiny distance apart (each shard splits its own
+    near-mirror edges); after the merge these tangled clusters deadlock
+    the batched collapse wave (any single contraction inverts a sliver
+    spanning the gap, so every direction is vetoed in parallel — while a
+    SEQUENTIAL pass resolves the chain pair by pair, trying both
+    directions, exactly like the reference's one-op-at-a-time remesher
+    would).  Host-side, O(pairs); pairs are vertices closer than
+    ``tol_rel`` x their metric size with BOTH tags clear, welded only
+    when every rewritten tet stays positive and every dying tet is
+    untagged.
+
+    Returns (tet, vkeep, tkeep) — updated connectivity plus vertex/tet
+    keep masks.
+    """
+    n = len(vert)
+    if met is None:
+        return tet, np.ones(n, bool), np.ones(len(tet), bool)
+    if met.ndim == 1:
+        href = met
+    else:  # aniso: isotropic proxy h ~ 1/sqrt(mean diagonal eigenvalue)
+        diag = (met[:, 0] + met[:, 3] + met[:, 5]) / 3.0
+        href = 1.0 / np.sqrt(np.maximum(diag, 1e-30))
+    free = vtag == 0
+    if not free.any():
+        return tet, np.ones(n, bool), np.ones(len(tet), bool)
+    # vectorized prefilter: any pair within the weld radius collides in
+    # at least one of the 8 half-cell-shifted grids at cell = 2*radius —
+    # O(n log n) numpy, no Python loops on the (typical) no-pair path
+    cell = max(1e-12, 2.0 * float(np.median(tol_rel * href[free])))
+    fidx = np.where(free)[0]
+    fv = vert[fidx]
+    sus = np.zeros(len(fidx), bool)
+    for sx in (0.0, 0.5):
+        for sy in (0.0, 0.5):
+            for sz in (0.0, 0.5):
+                k = np.floor(fv / cell +
+                             np.array([sx, sy, sz])).astype(np.int64)
+                kk = (k[:, 0] << 42) ^ (k[:, 1] << 21) ^ k[:, 2]
+                _, inv, cnts = np.unique(kk, return_inverse=True,
+                                         return_counts=True)
+                sus |= cnts[inv] > 1
+    cand_v = fidx[sus]
+    if not len(cand_v):
+        return tet, np.ones(n, bool), np.ones(len(tet), bool)
+    import collections
+    import itertools
+    key = np.round(vert / cell).astype(np.int64)
+    cells = collections.defaultdict(list)
+    for i in cand_v:
+        cells[tuple(key[i])].append(int(i))
+    cand_pairs = []
+    for k, lst in cells.items():
+        for dx in itertools.product((-1, 0, 1), repeat=3):
+            k2 = (k[0] + dx[0], k[1] + dx[1], k[2] + dx[2])
+            other = cells.get(k2)
+            if not other:
+                continue
+            for i in lst:
+                for j in other:
+                    if i < j:
+                        d = np.linalg.norm(vert[i] - vert[j])
+                        if d < tol_rel * min(href[i], href[j]):
+                            cand_pairs.append((d, i, j))
+    if not cand_pairs:
+        return tet, np.ones(n, bool), np.ones(len(tet), bool)
+    cand_pairs.sort()
+    # vertex -> tets incidence, restricted to tets touching a candidate
+    touch = np.isin(tet, cand_v).any(axis=1)
+    inc = collections.defaultdict(set)
+    for t_i in np.where(touch)[0]:
+        for v in tet[t_i]:
+            inc[int(v)].add(int(t_i))
+    tet = tet.copy()
+    tkeep = np.ones(len(tet), bool)
+    vkeep = np.ones(n, bool)
+
+    def try_weld(rm, kp):
+        ball = [t_i for t_i in inc[rm] if tkeep[t_i]]
+        dying, moved = [], []
+        for t_i in ball:
+            row = tet[t_i]
+            if kp in row:
+                # must carry no tags to die silently, and a weld must
+                # not bridge different regions
+                if ftag[t_i].any() or etag[t_i].any():
+                    return False
+                dying.append(t_i)
+            else:
+                moved.append(t_i)
+        if len({int(tref[t_i]) for t_i in ball}) > 1:
+            return False
+        for t_i in moved:
+            row = np.where(tet[t_i] == rm, kp, tet[t_i])
+            p = vert[row]
+            if np.dot(p[1] - p[0], np.cross(p[2] - p[0], p[3] - p[0])) \
+                    <= 1e-30:
+                return False
+        for t_i in dying:
+            tkeep[t_i] = False
+        for t_i in moved:
+            tet[t_i] = np.where(tet[t_i] == rm, kp, tet[t_i])
+            inc[kp].add(t_i)
+        vkeep[rm] = False
+        return True
+
+    nweld = 0
+    for _d, i, j in cand_pairs:
+        if not (vkeep[i] and vkeep[j]):
+            continue
+        if try_weld(j, i) or try_weld(i, j):
+            nweld += 1
+    return tet, vkeep, tkeep
+
+
+def grow_shards(shards: Mesh, mets, new_capP: int, new_capT: int):
+    """Grow every shard's capacity IN PLACE (stacked axis intact).
+
+    The static-shape analogue of the reference's realloc
+    (zaldy_pmmg.c:140-254) without the whole-mesh merge->resplit round
+    trip the old regrow path used: buffers are zero/False-padded on the
+    capacity axis, so vertex/tet SLOT IDS are preserved — the split-time
+    comm tables and frozen-interface contract remain valid, and host
+    involvement is O(1) metadata instead of O(mesh).
+    """
+    capP, capT = shards.vert.shape[1], shards.tet.shape[1]
+    dP, dT = new_capP - capP, new_capT - capT
+    if dP <= 0 and dT <= 0:
+        return shards, mets
+
+    def padP(x, fill=0):
+        pad = [(0, 0)] * x.ndim
+        pad[1] = (0, max(0, dP))
+        return jnp.pad(x, pad, constant_values=fill)
+
+    def padT(x, fill=0):
+        pad = [(0, 0)] * x.ndim
+        pad[1] = (0, max(0, dT))
+        return jnp.pad(x, pad, constant_values=fill)
+
+    out = dataclasses.replace(
+        shards,
+        vert=padP(shards.vert), vref=padP(shards.vref),
+        vtag=padP(shards.vtag), vmask=padP(shards.vmask, False),
+        tet=padT(shards.tet), tref=padT(shards.tref),
+        tmask=padT(shards.tmask, False), adja=padT(shards.adja, -1),
+        ftag=padT(shards.ftag), fref=padT(shards.fref),
+        etag=padT(shards.etag))
+    return out, padP(mets)
+
+
 def merge_shards(shards: Mesh, mets=None, return_part: bool = False):
     """Merge stacked shard Meshes back into one host Mesh (+ metric).
 
@@ -244,7 +399,31 @@ def merge_shards(shards: Mesh, mets=None, return_part: bool = False):
     vtag2[was_truebdy] |= MG_BDY
     vtag2[was_parbdy & ~was_truebdy] &= ~np.uint32(MG_BDY)
     vtag2[was_user_req] |= MG_REQ
-    m = make_mesh(vert[keep], tet, vref=vref[keep], tref=tref)
+
+    vert_k = vert[keep]
+    vref_k = vref[keep]
+    met_k = np.concatenate(all_met)[keep] if mets is not None else None
+    src_k = np.concatenate(all_src)
+    # sequential weld of near-coincident interior pairs left by
+    # independent refinement across the frozen interface (see
+    # _weld_close_pairs — the batched collapse deadlocks on these)
+    tet, vkeep2, tkeep2 = _weld_close_pairs(
+        vert_k, tet, vtag2, met_k, tref, ftag_m, etag_m)
+    if not (vkeep2.all() and tkeep2.all()):
+        nid = np.cumsum(vkeep2) - 1
+        tet = nid[tet[tkeep2]].astype(np.int32)
+        tref = tref[tkeep2]
+        ftag_m = ftag_m[tkeep2]
+        fref_m = fref_m[tkeep2]
+        etag_m = etag_m[tkeep2]
+        src_k = src_k[tkeep2]
+        vert_k = vert_k[vkeep2]
+        vref_k = vref_k[vkeep2]
+        vtag2 = vtag2[vkeep2]
+        if met_k is not None:
+            met_k = met_k[vkeep2]
+
+    m = make_mesh(vert_k, tet, vref=vref_k, tref=tref)
     vtag_full = np.zeros(m.capP, np.uint32)
     vtag_full[: len(vtag2)] = vtag2
     ftag_full = np.zeros((m.capT, 4), np.uint32)
@@ -260,10 +439,9 @@ def merge_shards(shards: Mesh, mets=None, return_part: bool = False):
     m = boundary_edge_tags(build_adjacency(m))
     out_met = None
     if mets is not None:
-        met = np.concatenate(all_met)[keep]
-        full = np.zeros((m.capP,) + met.shape[1:], met.dtype)
-        full[: len(met)] = met
+        full = np.zeros((m.capP,) + met_k.shape[1:], met_k.dtype)
+        full[: len(met_k)] = met_k
         out_met = jnp.asarray(full)
     if return_part:
-        return m, out_met, np.concatenate(all_src)
+        return m, out_met, src_k
     return m, out_met

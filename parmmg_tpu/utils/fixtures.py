@@ -60,6 +60,46 @@ def sphere_mesh(n: int = 8):
     return vert, tet.astype(np.int32)
 
 
+def torus_mesh(nu: int = 12, nc: int = 4, R: float = 1.0, r: float = 0.4):
+    """Solid torus: centerline radius R, tube radius r.
+
+    Square-to-disk mapped cross-section (nc cells across), extruded around
+    nu stations with periodic Kuhn cells — conforming across the wrap by
+    translation invariance of the Freudenthal split.  The genus-1 boundary
+    (Euler characteristic 0) is the fixture the reference CI matrix pulls
+    from its mesh repo (cmake/testing/pmmg_tests.cmake:25-38).
+    """
+    kc = nc + 1
+    g = np.arange(kc) / nc * 2.0 - 1.0
+    A, B = np.meshgrid(g, g, indexing="ij")
+    ab = np.stack([A.ravel(), B.ravel()], axis=1)
+    linf = np.max(np.abs(ab), axis=1)
+    l2 = np.linalg.norm(ab, axis=1)
+    scale = np.where(l2 > 1e-12, linf / np.maximum(l2, 1e-12), 1.0)
+    disk = ab * scale[:, None] * r                 # [(nc+1)^2, 2]
+    vert = []
+    for u in np.arange(nu) / nu * 2.0 * np.pi:
+        x = (R + disk[:, 0]) * np.cos(u)
+        y = (R + disk[:, 0]) * np.sin(u)
+        vert.append(np.stack([x, y, disk[:, 1]], axis=1))
+    vert = np.concatenate(vert)
+
+    def vid(i, j, l):
+        return (i % nu) * (kc * kc) + j * kc + l
+
+    ii, jj, ll = np.meshgrid(np.arange(nu), np.arange(nc), np.arange(nc),
+                             indexing="ij")
+    base = np.stack([ii.ravel(), jj.ravel(), ll.ravel()], 1)
+    corners = np.empty((base.shape[0], 8), np.int64)
+    for c in range(8):
+        off = np.array([c & 1, (c >> 1) & 1, (c >> 2) & 1])
+        q = base + off
+        corners[:, c] = vid(q[:, 0], q[:, 1], q[:, 2])
+    tet = corners[:, _KUHN_TETS].reshape(-1, 4)
+    tet = _orient_positive(vert, tet)
+    return vert, tet.astype(np.int32)
+
+
 def _orient_positive(vert, tet):
     p = vert[tet]
     det = np.einsum("ti,ti->t", p[:, 1] - p[:, 0],

@@ -72,3 +72,24 @@ def test_shard_overflow_degrades_to_lowfailure(monkeypatch):
 def test_success_path_still_returns_success():
     pm = _staged_pm(n_devices=1)
     assert pm.run() == C.PMMG_SUCCESS
+
+
+def test_shard_regrow_in_place(monkeypatch):
+    """Under-provisioned shards regrow IN PLACE (no merge->resplit) and
+    the run still completes: the zaldy realloc analogue."""
+    from parmmg_tpu.parallel import distribute
+    orig = distribute.split_to_shards
+
+    def tight_split(mesh, met, part, nparts, cap_mult=3.0, **kw):
+        return orig(mesh, met, part, nparts, cap_mult=1.05, **kw)
+
+    monkeypatch.setattr(distribute, "split_to_shards", tight_split)
+    pm = _staged_pm(n_devices=2)
+    assert pm.run() == C.PMMG_SUCCESS
+    from parmmg_tpu.core.mesh import tet_volumes
+    from parmmg_tpu.ops.adjacency import build_adjacency, check_adjacency
+    m = build_adjacency(pm._out)
+    assert check_adjacency(m) == {"asymmetric": 0, "face_mismatch": 0}
+    vols = np.asarray(tet_volumes(m))[np.asarray(m.tmask)]
+    assert (vols > 0).all()
+    assert np.isclose(vols.sum(), 1.0, rtol=1e-5)
