@@ -50,8 +50,10 @@ def build_metric(mesh: Mesh, met, info):
     met = clamp_metric(met, hmin, hmax)
     # surface-approximation size bound (Mmg defsiz -hausd route): chord
     # deviation under hausd needs h <= sqrt(8*hausd/kappa) on curved
-    # boundary regions
-    if info.hausd > 0:
+    # boundary regions.  Requires ridge detection: without MG_GEO tags a
+    # sharp edge is indistinguishable from smooth curvature and the
+    # curvature estimate blows up at corners
+    if info.hausd > 0 and info.angle_detection:
         from .ops.metric import hausd_metric_bound
         met = hausd_metric_bound(mesh, met, info.hausd, hmin)
     # local bounds BEFORE gradation (Mmg defsiz-then-gradsiz order) so the
@@ -162,6 +164,12 @@ def parmmg_run(pm) -> tuple[Mesh, object, AdaptStats]:
     for _typ, _ref, _hm, _hx, _hd in info.local_params:
         if _hd and _hd > 0:
             hausd = min(hausd, _hd)
+    if not info.angle_detection:
+        # -nr: no ridge tags -> the Bezier lift cannot tell a sharp
+        # feature from smooth curvature; fall back to piecewise-linear
+        # boundary placement (conservative; Mmg with -nr instead rounds
+        # features — tracked as a semantic divergence)
+        hausd = None
     if info.n_devices <= 1:
         import jax
         import jax.numpy as jnp
