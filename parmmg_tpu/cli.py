@@ -121,6 +121,10 @@ def main(argv=None) -> int:
             parts.append(load_distributed_mesh(inp, r)[0])
             r += 1
         m = _concat_shards(parts)
+        # distributed input stays distributed: the run adopts the
+        # caller's decomposition (libparmmg.c:206-329 semantics) when the
+        # device count matches the shard count
+        pm._in_part = getattr(m, "src_part", None)
     elif inp.exists():
         m = medit.read_mesh(inp)
     else:
@@ -272,18 +276,26 @@ def _parse_parfile(path):
 
 
 def _concat_shards(parts):
+    """Reassemble distributed shard files into one mesh + the per-tet
+    source-shard labels.  The labels preserve the CALLER'S partition so
+    the distributed run adopts it instead of re-partitioning from
+    scratch — the reference's distributed entry keeps the input
+    decomposition and only rebuilds communicators (libparmmg.c:206-329).
+    """
     from .io.medit import MeditMesh
     m = MeditMesh()
     off = 0
-    vs, vr, ts, tr = [], [], [], []
-    for p in parts:
+    vs, vr, ts, tr, src = [], [], [], [], []
+    for k, p in enumerate(parts):
         vs.append(p.vert); vr.append(p.vref)
         ts.append(p.tetra + off); tr.append(p.tref)
+        src.append(np.full(len(p.tetra), k, np.int32))
         off += len(p.vert)
     m.vert = np.concatenate(vs)
     m.vref = np.concatenate(vr)
     m.tetra = np.concatenate(ts)
     m.tref = np.concatenate(tr)
+    m.src_part = np.concatenate(src)
     # duplicate interface vertices are deduplicated by the core merge on
     # exact coordinates at run() time via analysis; cheap dedup here:
     uniq, inv = np.unique(m.vert.round(12), axis=0, return_inverse=True)

@@ -173,7 +173,59 @@ def parmmg_run(pm) -> tuple[Mesh, object, AdaptStats]:
     if info.n_devices <= 1:
         import jax
         import jax.numpy as jnp
+        from .api.params import resolve_target_mesh_size
+        from .parallel.groups import how_many_groups, grouped_adapt
         niter = max(1, info.niter)
+        ne0 = int(np.asarray(mesh.tmask).sum())
+        target = resolve_target_mesh_size(info, ne0, 1)
+        if how_many_groups(ne0, target) >= 2:
+            # two-level decomposition (-mesh-size below the mesh size):
+            # sub-device groups traversed with lax.map so peak HBM is one
+            # group's working set (grpsplit_pmmg.c:1551 role; see
+            # parallel/groups.py).  Interface seams are displaced between
+            # iterations like rank interfaces.
+            backup = (jax.tree.map(jnp.copy, mesh), jnp.copy(met))
+            degraded = False
+            try:
+                with tim("adaptation"):
+                    mesh, met = grouped_adapt(
+                        mesh, met, target, niter=niter,
+                        verbose=3 if info.imprim >= C.PMMG_VERB_ITWAVES
+                        else 0, stats=stats,
+                        noinsert=info.noinsert, noswap=info.noswap,
+                        nomove=info.nomove, hausd=hausd,
+                        ifc_layers=info.ifc_layers)
+            except MemoryError:
+                mesh, met = backup
+                stats.status = C.PMMG_LOWFAILURE
+                degraded = True
+            except Exception as e:  # device OOM = XlaRuntimeError
+                if "RESOURCE_EXHAUSTED" not in str(e) and \
+                        "Out of memory" not in str(e):
+                    raise
+                mesh, met = backup
+                stats.status = C.PMMG_LOWFAILURE
+                degraded = True
+            # bad-element polish on the merged mesh (the same contract as
+            # the other two paths — group seams breed slivers)
+            if not degraded and not (info.noinsert and info.noswap
+                                     and info.nomove):
+                from .ops.adapt import sliver_polish
+                with tim("bad-element polish"):
+                    for w in range(4):
+                        mesh, counts = sliver_polish(
+                            mesh, met, jnp.asarray(1000 + w, jnp.int32),
+                            do_collapse=not info.noinsert,
+                            do_swap=not info.noswap,
+                            do_smooth=not info.nomove, hausd=hausd)
+                        pc = np.asarray(counts)
+                        stats.ncollapse += int(pc[0])
+                        stats.nswap += int(pc[1])
+                        stats.nmoved += int(pc[2])
+                        if int(pc[0]) == 0 and int(pc[1]) == 0:
+                            break
+            return _finish_run(pm, mesh, met, stats, info, tim,
+                               bg_mesh, bg_fields, hausd)
         for it in range(niter):
             # the jitted cycles DONATE their input buffers, so the
             # pre-iteration binding would be dead after a failure; keep a
@@ -203,29 +255,44 @@ def parmmg_run(pm) -> tuple[Mesh, object, AdaptStats]:
                 break
             stats += st
     else:
-        from .parallel.dist import distributed_adapt
+        from .parallel.dist import (distributed_adapt,
+                                    distributed_adapt_multi,
+                                    ShardOverflowError)
         from .parallel.partition import move_interfaces
-        from .parallel.dist import ShardOverflowError
         part = None
         niter = max(1, info.niter)
-        for it in range(niter):
+        vrb = 3 if info.imprim >= C.PMMG_VERB_ITWAVES else 0
+        if info.repartitioning == C.REPART_IFC_DISPLACEMENT:
+            # default mode: shard-RESIDENT outer loop — one split, then
+            # niter adapt passes with incremental interface-band
+            # migration between them (advancing-front flood on device +
+            # O(band) host orchestration, parallel/migrate.py), one merge
+            # at the end.  No whole-mesh merge happens between outer
+            # iterations — the reference's migrate-only-moving-groups
+            # design (loadbalancing_pmmg.c + distributegrps_pmmg.c)
+            # distributed input stays distributed: adopt the caller's
+            # partition when it matches the device count (the reference
+            # preserves the input decomposition and only rebuilds comms,
+            # libparmmg.c:206-329); the dedup at load time kept tet order
+            in_part = getattr(pm, "_in_part", None)
+            n_t0 = int(np.asarray(mesh.tmask).sum())
+            # the shard COUNT must equal the device count: fewer shards
+            # would leave devices permanently empty (the flood never
+            # populates a shard that shares no interface)
+            if in_part is not None and (
+                    len(in_part) != n_t0
+                    or int(in_part.max()) + 1 != info.n_devices):
+                in_part = None
             try:
                 with tim("adaptation"):
-                    # tags (ridge/corner/ref classification included) are
-                    # maintained through the shards: distributed_adapt
-                    # runs the cross-shard analysis refresh before
-                    # merging, so no whole-mesh re-analysis happens here
-                    # (the PMMG_update_analys design, analys_pmmg.c:1571)
-                    mesh, met, part = distributed_adapt(
-                        mesh, met, info.n_devices, part=part,
-                        verbose=3 if info.imprim >= C.PMMG_VERB_ITWAVES
-                        else 0,
-                        stats=stats, noinsert=info.noinsert,
-                        noswap=info.noswap, nomove=info.nomove,
-                        angedg=angedg, hausd=hausd)
+                    mesh, met, part = distributed_adapt_multi(
+                        mesh, met, info.n_devices, niter=niter,
+                        verbose=vrb, stats=stats,
+                        noinsert=info.noinsert, noswap=info.noswap,
+                        nomove=info.nomove, angedg=angedg, hausd=hausd,
+                        ifc_layers=info.ifc_layers,
+                        nobalancing=info.nobalancing, part=in_part)
             except ShardOverflowError as e:
-                # degrade to LOWFAILURE with the conforming merged state
-                # (failed_handling, libparmmg1.c:974-1011)
                 mesh, met, part = e.mesh, e.met, e.part
                 stats.status = C.PMMG_LOWFAILURE
                 if info.imprim >= 0:
@@ -233,17 +300,32 @@ def parmmg_run(pm) -> tuple[Mesh, object, AdaptStats]:
                     print("  ## Warning: shard capacity exhausted; "
                           "saving the last conforming mesh "
                           "(LOWFAILURE).", file=sys.stderr)
-                break
-            if it + 1 < niter and not info.nobalancing \
-                    and info.repartitioning == C.REPART_IFC_DISPLACEMENT:
-                # displace old interfaces into shard interiors so the
-                # next pass can remesh them (loadbalancing_pmmg.c flow)
-                with tim("load balancing"):
-                    _, tet_h, _, _, _ = mesh_to_host(mesh)
-                    part = move_interfaces(tet_h, part, info.n_devices,
-                                           nlayers=info.ifc_layers)
-            elif it + 1 < niter:
-                part = None          # fresh graph partition next iter
+        else:
+            # graph-balancing mode: the reference gathers the group graph
+            # on rank 0 and re-partitions globally (metis_pmmg.c:1343) —
+            # the merge-repartition-resplit round trip is inherent here
+            for it in range(niter):
+                try:
+                    with tim("adaptation"):
+                        mesh, met, part = distributed_adapt(
+                            mesh, met, info.n_devices, part=part,
+                            verbose=vrb,
+                            stats=stats, noinsert=info.noinsert,
+                            noswap=info.noswap, nomove=info.nomove,
+                            angedg=angedg, hausd=hausd)
+                except ShardOverflowError as e:
+                    # degrade to LOWFAILURE with the conforming merged
+                    # state (failed_handling, libparmmg1.c:974-1011)
+                    mesh, met, part = e.mesh, e.met, e.part
+                    stats.status = C.PMMG_LOWFAILURE
+                    if info.imprim >= 0:
+                        import sys
+                        print("  ## Warning: shard capacity exhausted; "
+                              "saving the last conforming mesh "
+                              "(LOWFAILURE).", file=sys.stderr)
+                    break
+                if it + 1 < niter:
+                    part = None      # fresh graph partition next iter
         # bad-element optimization on the merged mesh (same contract as
         # the single-device path: sliver_polish after the sizing loop)
         if not (info.noinsert and info.noswap and info.nomove):
@@ -266,6 +348,15 @@ def parmmg_run(pm) -> tuple[Mesh, object, AdaptStats]:
                         break
         pm._out_part = part          # reused by distributed output
 
+    return _finish_run(pm, mesh, met, stats, info, tim, bg_mesh,
+                       bg_fields, hausd)
+
+
+def _finish_run(pm, mesh, met, stats, info, tim, bg_mesh, bg_fields,
+                hausd):
+    """Common run tail: sequential sliver repair, user-field
+    interpolation, reports.  Shared by the whole-mesh, grouped and
+    distributed paths."""
     # sequential last-resort repair: tangled sliver clusters (stacked
     # near-flat tets, typically born at former frozen interfaces) veto
     # every BATCHED fix — each parallel op inverts a neighbor — while the
@@ -318,18 +409,34 @@ def print_quality_report(mesh: Mesh, met, info) -> None:
 def interpolate_fields(bg: Mesh, fields: list[np.ndarray], new: Mesh)\
         -> list[np.ndarray]:
     """Background P1 interpolation of user fields onto the new vertices
-    (PMMG_interpMetricsAndFields semantics, interpmesh_pmmg.c:663)."""
+    (PMMG_interpMetricsAndFields semantics, interpmesh_pmmg.c:663).
+
+    Boundary vertices interpolate from the background SURFACE (triangle
+    walk, ops.interp.locate_points_bdy — the PMMG_locatePointBdy split of
+    interpmesh_pmmg.c:535-620): a volume walk puts a curved-boundary
+    point inside some tet whose P1 restriction misrepresents the surface
+    field."""
     import jax.numpy as jnp
-    from .ops.interp import locate_points, interp_p1
+    from .core.constants import MG_BDY
+    from .ops.interp import (locate_points, locate_points_bdy, interp_p1,
+                             interp_p1_tri)
 
     vm = np.asarray(new.vmask)
     pts = np.asarray(new.vert)[vm]
+    on_bdy = (np.asarray(new.vtag)[vm] & MG_BDY) != 0
     loc = locate_points(bg, jnp.asarray(pts, new.vert.dtype),
                         jnp.zeros(len(pts), jnp.int32))
+    sloc = locate_points_bdy(bg, jnp.asarray(pts, new.vert.dtype)) \
+        if on_bdy.any() else None
     out = []
     for f in fields:
         full = np.zeros((bg.capP,) + f.shape[1:], f.dtype)
         full[: len(f)] = f
         vals = np.asarray(interp_p1(jnp.asarray(full), bg.tet, loc))
+        if sloc is not None:
+            vals_b = np.asarray(interp_p1_tri(jnp.asarray(full), bg,
+                                              sloc))
+            sel = on_bdy.reshape(on_bdy.shape + (1,) * (vals.ndim - 1))
+            vals = np.where(sel, vals_b, vals)
         out.append(vals)
     return out

@@ -153,6 +153,202 @@ def interp_metric_ani(met6: jax.Array, bg_tet: jax.Array, loc: LocateResult):
                       out[:, 1, 1], out[:, 1, 2], out[:, 2, 2]], -1)
 
 
+# ---------------------------------------------------------------------------
+# surface localization (PMMG_locatePointBdy analogue, locate_pmmg.c:587)
+# ---------------------------------------------------------------------------
+class SurfLocateResult(NamedTuple):
+    tri: jax.Array     # [M] int32 surface slot (4*tet+face) in the bg mesh
+    bary: jax.Array    # [M,3] triangle barycentric coordinates (clipped)
+    dist: jax.Array    # [M] distance to the triangle plane (signed)
+    failed: jax.Array  # [M] bool walk failed (closest-tria fallback used)
+
+
+def surface_triangulation(bg: Mesh):
+    """Background boundary surface as a static-shape triangle soup.
+
+    Returns (tri [4T,3] vertex ids, fmask [4T], tadj [4T,3]): one slot per
+    (tet, face); ``tadj[t, i]`` is the neighbor surface slot across the
+    edge opposite local vertex i (or -1).  The sort-based edge pairing is
+    the surface analogue of build_adjacency — replaces the reference's
+    ``PMMG_precompute_nodeTrias`` + hash walk prep (locate_pmmg.c:68-206).
+    Non-manifold edges (> 2 incident boundary faces) pair arbitrarily;
+    the exhaustive fallback covers walks that cross them wrongly.
+    """
+    from ..core.constants import IDIR, MG_BDY
+    from .edges import PACK_LIMIT
+    capT = bg.capT
+    F = capT * 4
+    fmask = ((bg.ftag & MG_BDY) != 0) & bg.tmask[:, None]
+    tri = bg.tet[:, jnp.asarray(IDIR)].reshape(F, 3)
+    fm = fmask.reshape(F)
+    big = jnp.iinfo(jnp.int32).max
+    # the 3 edges of each tri, edge i opposite local vertex i
+    e_pairs = [(1, 2), (0, 2), (0, 1)]
+    tadj = jnp.full((F, 3), -1, jnp.int32)
+    slot = jnp.arange(F, dtype=jnp.int32)
+    kas, kbs, slots, eloc = [], [], [], []
+    for i, (a, b) in enumerate(e_pairs):
+        kas.append(jnp.where(fm, jnp.minimum(tri[:, a], tri[:, b]), big))
+        kbs.append(jnp.where(fm, jnp.maximum(tri[:, a], tri[:, b]), big))
+        slots.append(slot)
+        eloc.append(jnp.full(F, i, jnp.int32))
+    ka = jnp.concatenate(kas)
+    kb = jnp.concatenate(kbs)
+    sl = jnp.concatenate(slots)
+    el = jnp.concatenate(eloc)
+    if bg.capP <= PACK_LIMIT:
+        # both ids fit one int32 key (the edges.py packing convention)
+        k = jnp.where(ka == big, big, ka * bg.capP + kb)
+        order = jnp.argsort(k)
+        ks = k[order]
+        invalid = ks == big
+        eq_next = (ks[1:] == ks[:-1]) & ~invalid[:-1]
+    else:
+        # no x64 on TPU: two-column lexsort instead of packing (the same
+        # fallback ops/edges.py:sort_pairs uses)
+        order = jnp.lexsort((kb, ka))
+        ka_s, kb_s = ka[order], kb[order]
+        invalid = ka_s == big
+        eq_next = (ka_s[1:] == ka_s[:-1]) & (kb_s[1:] == kb_s[:-1]) \
+            & ~invalid[:-1]
+    sls, els = sl[order], el[order]
+    same_next = jnp.concatenate([eq_next, jnp.array([False])])
+    same_prev = jnp.concatenate([jnp.array([False]), eq_next])
+    idx = jnp.arange(3 * F)
+    partner = jnp.where(same_next, idx + 1,
+                        jnp.where(same_prev, idx - 1, idx))
+    matched = same_next | same_prev
+    nb_slot = jnp.where(matched, sls[partner], -1)
+    tadj = tadj.at[sls, els].set(nb_slot, unique_indices=True)
+    return tri, fm, tadj
+
+
+def _bar_tri(p, a, b, c):
+    n = jnp.cross(b - a, c - a)
+    n2 = jnp.maximum(jnp.sum(n * n), EPSD)
+    w0 = jnp.sum(jnp.cross(b - p, c - p) * n)
+    w1 = jnp.sum(jnp.cross(c - p, a - p) * n)
+    w2 = jnp.sum(jnp.cross(a - p, b - p) * n)
+    bar = jnp.stack([w0, w1, w2]) / n2
+    dist = jnp.sum((p - a) * n) / jnp.sqrt(n2)
+    return bar, dist
+
+
+def locate_points_bdy(bg: Mesh, points: jax.Array,
+                      start: jax.Array | None = None,
+                      max_steps: int = 256,
+                      tol: float = -1e-4) -> SurfLocateResult:
+    """Surface walk-localization of boundary points on the background
+    boundary triangulation (PMMG_locatePointBdy, locate_pmmg.c:587).
+
+    The walk moves across the edge with the most negative projected
+    barycentric; vertex/edge hits (the reference's cone/wedge tests,
+    locate_pmmg.c:209,286) are realized by CLIPPED barycentrics — a point
+    past a vertex/edge interpolates from that vertex/edge exactly, the
+    ``PMMG_barycoord2d_getClosest`` semantics (barycoord_pmmg.c:324).
+    """
+    tri, fm, tadj = surface_triangulation(bg)
+    first = jnp.argmax(fm).astype(jnp.int32)   # some surface slot
+    if start is None:
+        start = jnp.full(points.shape[0], first, jnp.int32)
+    else:
+        start = jnp.where(fm[jnp.clip(start, 0, tri.shape[0] - 1)],
+                          start, first).astype(jnp.int32)
+
+    def walk_one(pt, t0):
+        def cond(state):
+            t, done, steps = state
+            return (~done) & (steps < max_steps)
+
+        def body(state):
+            t, done, steps = state
+            v = bg.vert[tri[t]]
+            bar, _ = _bar_tri(pt, v[0], v[1], v[2])
+            inside = jnp.min(bar) >= tol
+            worst = jnp.argmin(bar)
+            nxt = tadj[t, worst]
+            blocked = nxt < 0
+            new_t = jnp.where(inside | blocked, t, nxt)
+            return new_t.astype(jnp.int32), inside | blocked, steps + 1
+
+        t, done, _ = jax.lax.while_loop(
+            cond, body, (t0, False, 0))
+        v = bg.vert[tri[t]]
+        bar, dist = _bar_tri(pt, v[0], v[1], v[2])
+        ok = jnp.min(bar) >= tol
+        # distance of the CLIPPED point: the projected-inside test alone
+        # is wrong on closed surfaces (a point on one side of the body
+        # projects inside far triangles on the other side); the true
+        # closest triangle is arbitrated below
+        cb = jnp.clip(bar, 0.0, 1.0)
+        cb = cb / jnp.maximum(jnp.sum(cb), EPSD)
+        dclip = jnp.linalg.norm(pt - cb @ v)
+        return t, bar, dist, ~ok, dclip
+
+    tids, bary, dist, failed, dwalk = jax.vmap(walk_one)(points, start)
+
+    # exhaustive closest-triangle fallback (locate_pmmg.c:737 flavor):
+    # clip barycentrics to the simplex, evaluate the clipped point, take
+    # the nearest masked triangle
+    def exhaustive(pt):
+        v = bg.vert[tri]                                  # [F,3,3]
+        n = jnp.cross(v[:, 1] - v[:, 0], v[:, 2] - v[:, 0])
+        n2 = jnp.maximum(jnp.sum(n * n, -1), EPSD)
+        w0 = jnp.sum(jnp.cross(v[:, 1] - pt, v[:, 2] - pt) * n, -1)
+        w1 = jnp.sum(jnp.cross(v[:, 2] - pt, v[:, 0] - pt) * n, -1)
+        w2 = jnp.sum(jnp.cross(v[:, 0] - pt, v[:, 1] - pt) * n, -1)
+        bar = jnp.stack([w0, w1, w2], -1) / n2[:, None]
+        cb = jnp.clip(bar, 0.0, 1.0)
+        cb = cb / jnp.maximum(jnp.sum(cb, -1, keepdims=True), EPSD)
+        q = jnp.einsum("fk,fkd->fd", cb, v)
+        d = jnp.sum((pt - q) ** 2, -1)
+        d = jnp.where(fm, d, jnp.inf)
+        best = jnp.argmin(d)
+        return best.astype(jnp.int32), cb[best], jnp.sqrt(d[best])
+
+    fb_t, fb_b, fb_d = jax.vmap(exhaustive)(points)
+    # the closest triangle is authoritative whenever it is meaningfully
+    # closer than the walk's landing spot (wrong-side landings on closed
+    # surfaces); the walk is the accelerator, not the arbiter — the
+    # role split of PMMG_locatePointBdy + closest-tria fallback
+    use_fb = failed | (dwalk > fb_d * (1.0 + 1e-3) + 1e-12)
+    tids = jnp.where(use_fb, fb_t, tids)
+    bary = jnp.where(use_fb[:, None], fb_b, bary)
+    dist = jnp.where(use_fb, fb_d, dist)
+    return SurfLocateResult(tids, bary, dist, use_fb)
+
+
+def interp_p1_tri(values: jax.Array, bg: Mesh, loc: SurfLocateResult):
+    """P1 interpolation over the located surface triangle
+    (PMMG_interp3bar_iso semantics, interpmesh_pmmg.c:50-120)."""
+    from ..core.constants import IDIR
+    tri = bg.tet[:, jnp.asarray(IDIR)].reshape(bg.capT * 4, 3)
+    w = jnp.clip(loc.bary, 0.0, 1.0)
+    w = w / jnp.maximum(jnp.sum(w, -1, keepdims=True), EPSD)
+    tv = tri[loc.tri]                                     # [M,3]
+    vals = values[tv]                                     # [M,3,...]
+    wexp = w.reshape(w.shape + (1,) * (vals.ndim - 2))
+    return jnp.sum(vals * wexp, axis=1)
+
+
+def interp_metric_ani_tri(met6: jax.Array, bg: Mesh,
+                          loc: SurfLocateResult):
+    """Aniso inverse-tensor interpolation over the surface triangle
+    (PMMG_interp3bar_ani, interpmesh_pmmg.c:240-271)."""
+    from ..core.constants import IDIR
+    from .quality import unpack_sym
+    tri = bg.tet[:, jnp.asarray(IDIR)].reshape(bg.capT * 4, 3)
+    w = jnp.clip(loc.bary, 0.0, 1.0)
+    w = w / jnp.maximum(jnp.sum(w, -1, keepdims=True), EPSD)
+    tv = tri[loc.tri]
+    M = unpack_sym(met6[tv])                              # [M,3,3,3]
+    Minv = jnp.linalg.inv(M + jnp.eye(3) * EPSD)
+    comb = jnp.einsum("mk,mkij->mij", w, Minv)
+    out = jnp.linalg.inv(comb + jnp.eye(3) * EPSD)
+    return jnp.stack([out[:, 0, 0], out[:, 0, 1], out[:, 0, 2],
+                      out[:, 1, 1], out[:, 1, 2], out[:, 2, 2]], -1)
+
+
 def interpolate_from_background(bg: Mesh, bg_met: jax.Array,
                                 mesh: Mesh, met: jax.Array,
                                 bg_fields: jax.Array | None = None,
@@ -165,21 +361,47 @@ def interpolate_from_background(bg: Mesh, bg_met: jax.Array,
     ``only_new``: bool [capP] — vertices to overwrite (default: all valid);
     others keep their current values (the reference copies unmoved/required
     points directly, interpmesh_pmmg.c:432).
+
+    Boundary vertices are localized on the background SURFACE (triangle
+    walk, locate_points_bdy) and interpolated from the located triangle —
+    the reference's split between PMMG_locatePointBdy and
+    PMMG_locatePointVol (interpmesh_pmmg.c:535-620): a volume walk puts a
+    curved-surface point inside some tet whose P1 field is wrong for a
+    point that geometrically lives on the surface.
+
     Returns (met', fields' or None, LocateResult).
     """
+    from ..core.constants import MG_BDY
     sel = mesh.vmask if only_new is None else (only_new & mesh.vmask)
     pts = mesh.vert
     if start is None:
         start = jnp.zeros(mesh.capP, jnp.int32)
     loc = locate_points(bg, pts, start)
+    on_bdy = (mesh.vtag & MG_BDY) != 0
+    # host-level guard (this is a host-driver function, not jitted): skip
+    # the surface pass entirely when no query vertex is on the boundary
+    use_surf = bool(jnp.any(on_bdy & sel))
+    sloc = locate_points_bdy(bg, pts) if use_surf else None
     if bg_met.ndim == 1:
         met_i = interp_p1(bg_met, bg.tet, loc)
+        met_b = interp_p1_tri(bg_met, bg, sloc) if use_surf else None
     else:
         met_i = interp_metric_ani(bg_met, bg.tet, loc)
+        met_b = interp_metric_ani_tri(bg_met, bg, sloc) \
+            if use_surf else None
+    if use_surf:
+        met_i = jnp.where(
+            on_bdy.reshape(on_bdy.shape + (1,) * (met_i.ndim - 1)),
+            met_b, met_i)
     met_out = jnp.where(sel.reshape(sel.shape + (1,) * (met.ndim - 1)),
                         met_i.astype(met.dtype), met)
     fields_out = None
     if bg_fields is not None:
         f_i = interp_p1(bg_fields, bg.tet, loc)
+        if use_surf:
+            f_b = interp_p1_tri(bg_fields, bg, sloc)
+            f_i = jnp.where(
+                on_bdy.reshape(on_bdy.shape + (1,) * (f_i.ndim - 1)),
+                f_b, f_i)
         fields_out = f_i
     return met_out, fields_out, loc

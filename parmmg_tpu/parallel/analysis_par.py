@@ -175,9 +175,12 @@ def analyze_shards(verts: list[np.ndarray], tets: list[np.ndarray],
     for s in range(S):
         g = glo[s]
         safe = np.clip(g, 0, maxg - 1)
-        vt = np.where(g < maxg, gtag[safe], 0).astype(np.uint32)
+        # rows with g < 0 are dead slots (the session numbering marks
+        # reusable holes with -1): no classification, no normal
+        ok = (g >= 0) & (g < maxg)
+        vt = np.where(ok, gtag[safe], 0).astype(np.uint32)
         vtag_add.append(vt)
-        vn = gacc[safe]
+        vn = np.where(ok[:, None], gacc[safe], 0.0)
         nl = np.linalg.norm(vn, axis=1, keepdims=True)
         vnormal.append(np.where(nl > 1e-30, vn / np.maximum(nl, 1e-30), 0))
         # special edges present in this shard (by its own records)

@@ -110,11 +110,34 @@ def build_interface_comms(tet: np.ndarray, part: np.ndarray,
                     node_lists[a][b].append(int(g))
                     node_lists[b][a].append(int(g))
 
-    # ---- pad into tables -------------------------------------------------
+    # ---- convert to local indices and pad into tables --------------------
+    node_loc = [[(g2l[s][np.asarray(node_lists[s][b], np.int64)].tolist()
+                  if node_lists[s][b] else [])
+                 for b in range(S)] for s in range(S)]
+    face_loc = [[(_global_face_to_local(
+                    np.asarray(face_lists[s][b], np.int64), part,
+                    s).tolist() if face_lists[s][b] else [])
+                 for b in range(S)] for s in range(S)]
+    owner = []
+    for s in range(S):
+        ow = owner_g[l2g[s]].astype(np.int32)
+        ow[ow < 0] = s
+        owner.append(ow)
+    return pad_comm_tables(node_loc, face_loc, owner, S)
+
+
+def pad_comm_tables(node_lists, face_lists, owner,
+                    n_shards: int) -> InterfaceComms:
+    """Pad per-pair item lists (LOCAL indices, both-sides-identical
+    order — the A.4 contract) into the device-ready InterfaceComms
+    layout.  Single source of truth for the padding/K>=1 clamps, shared
+    by build_interface_comms and the migration rebuild
+    (parallel/migrate.py)."""
+    S = n_shards
     nbrs = [[b for b in range(S)
              if b != s and (node_lists[s][b] or face_lists[s][b])]
             for s in range(S)]
-    K = max(1, max(len(x) for x in nbrs))
+    K = max(1, max((len(x) for x in nbrs), default=1))
     In = max(1, max((len(node_lists[s][b]) for s in range(S)
                      for b in range(S)), default=1))
     If = max(1, max((len(face_lists[s][b]) for s in range(S)
@@ -124,25 +147,14 @@ def build_interface_comms(tet: np.ndarray, part: np.ndarray,
     node_cnt = np.zeros((S, K), np.int32)
     face_idx = np.full((S, K, If), -1, np.int32)
     face_cnt = np.zeros((S, K), np.int32)
-    owner = []
     for s in range(S):
-        ow = owner_g[l2g[s]].astype(np.int32)
-        ow[ow < 0] = s
-        owner.append(ow)
         for k, b in enumerate(nbrs[s]):
             nbr[s, k] = b
-            nl = g2l[s][np.asarray(node_lists[s][b], np.int64)] \
-                if node_lists[s][b] else np.zeros(0, np.int64)
+            nl = node_lists[s][b]
             node_idx[s, k, : len(nl)] = nl
             node_cnt[s, k] = len(nl)
-            # face slots: global face slot id -> local tet slot
             fl = face_lists[s][b]
-            if fl:
-                gt = np.asarray(fl, np.int64)
-                # local tet index of global tet (tets of shard s keep
-                # their order): build map once per shard
-                face_idx[s, k, : len(fl)] = _global_face_to_local(
-                    gt, part, s)
+            face_idx[s, k, : len(fl)] = fl
             face_cnt[s, k] = len(fl)
     return InterfaceComms(nbr, node_idx, node_cnt, face_idx, face_cnt,
                           owner)

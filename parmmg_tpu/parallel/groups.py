@@ -1,0 +1,160 @@
+"""Two-level decomposition: sub-device remesh groups.
+
+The reference splits each rank's mesh into ``-mesh-size``-element groups
+and remeshes them one at a time (``PMMG_splitPart_grps`` / ``howManyGroups``
+grpsplit_pmmg.c:47,1551-1614, capped at ``PMMG_REMESHER_NGRPS_MAX``); the
+group is the unit that bounds the remesher's working set.  TPU-native
+analogue: groups are slots of a stacked pytree traversed with ``lax.map``
+— XLA compiles ONE cycle program for the group shape and executes it per
+group, so peak HBM scales with the GROUP capacity, not the mesh.  Mesh
+size per chip is then bounded by HBM-for-one-group x ngroups, which is
+what makes the 10M-tet configuration reachable on a single chip.  (A
+``vmap`` over groups would process them concurrently — same peak memory
+as no groups at all; ``map`` is the memory-bounding choice.  Groups also
+shorten the O(n log^2 n) TPU sorts inside each wave.)
+
+Group interfaces are frozen exactly like rank interfaces (MG_PARBDY —
+the same ``split_to_shards`` freeze contract, tag_pmmg.c:39-124) and
+displaced between outer iterations with the same advancing-front
+machinery, so previously-frozen group seams get remeshed later — the
+two-level loop of the reference.
+
+``-metis-ratio`` note: the reference multiplies the group count by
+``metis_ratio`` for the REDISTRIBUTION split, whose many small groups are
+the METIS graph nodes (grpsplit_pmmg.c:1595-1614).  This framework
+migrates interface bands directly (parallel/migrate.py) instead of
+re-partitioning a group graph, so the flag has no load-bearing role; it
+is parsed and validated for CLI parity only.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.mesh import Mesh
+from ..core import constants as C
+
+
+def how_many_groups(ne: int, target: int) -> int:
+    """Group count with the reference's clamps (grpsplit_pmmg.c:47)."""
+    if target <= 0:
+        return 1
+    return max(1, min((ne + target - 1) // target, C.REMESHER_NGRPS_MAX))
+
+
+def grouped_adapt_pass(mesh: Mesh, met, ngroups: int, cycles: int = 12,
+                       part: np.ndarray | None = None,
+                       verbose: int = 0, stats=None,
+                       noinsert: bool = False, noswap: bool = False,
+                       nomove: bool = False, hausd: float | None = None):
+    """One outer pass: split into groups, run adapt cycles with lax.map
+    over the group axis, merge.  Returns (mesh, met, part_of_merged).
+
+    The per-group program is the SAME adapt_cycle_impl as the whole-mesh
+    path (frozen MG_PARBDY group seams make it correct); the map axis
+    serializes groups so HBM holds one group's working set at a time.
+    """
+    from ..ops.adapt import adapt_cycle_impl
+    from .partition import morton_partition, fix_contiguity
+    from .distribute import split_to_shards, merge_shards, grow_shards
+    from ..core.mesh import mesh_to_host
+
+    vert_h, tet_h, _, _, _ = mesh_to_host(mesh)
+    if part is None:
+        cent = vert_h[tet_h].mean(axis=1)
+        part = fix_contiguity(tet_h, morton_partition(cent, ngroups))
+    stacked, met_s = split_to_shards(mesh, met, part, ngroups,
+                                     cap_mult=3.0)
+
+    def one_cycle(do_swap: bool, do_smooth: bool, do_insert: bool):
+        def body(args):
+            m, k, wave = args
+            m, k, counts = adapt_cycle_impl(
+                m, k, wave, do_swap=do_swap, do_smooth=do_smooth,
+                do_insert=do_insert, hausd=hausd)
+            return m, k, counts
+
+        @jax.jit
+        def run(stacked, met_s, wave):
+            waves = jnp.full(ngroups, wave, jnp.int32)
+            m, k, counts = jax.lax.map(body, (stacked, met_s, waves))
+            return m, k, counts
+
+        return run
+
+    step_full = one_cycle(not noswap, not nomove, not noinsert)
+    step_light = step_full if noswap else one_cycle(
+        False, not nomove, not noinsert)
+
+    c = 0
+    regrows = 0
+    while c < cycles:
+        step = step_full if (c % 3 == 2 or c >= cycles - 2) else step_light
+        stacked, met_s, counts = step(stacked, met_s,
+                                      jnp.asarray(c, jnp.int32))
+        cs = np.asarray(counts)                   # [G, 6]
+        tot = cs.sum(axis=0)
+        if stats is not None:
+            stats.nsplit += int(tot[0])
+            stats.ncollapse += int(tot[1])
+            stats.nswap += int(tot[2])
+            stats.nmoved += int(tot[3])
+            stats.cycles += 1
+        if verbose >= 3:
+            print(f"  grp cycle {c}: split {tot[0]} collapse {tot[1]} "
+                  f"swap {tot[2]} move {tot[3]} over {ngroups} groups")
+        if int(tot[4]) != 0:
+            if regrows >= 6:
+                raise MemoryError("group capacity exhausted")
+            capP = stacked.vert.shape[1]
+            capT = stacked.tet.shape[1]
+            stacked, met_s = grow_shards(stacked, met_s, 2 * capP,
+                                         2 * capT)
+            regrows += 1
+            continue
+        c += 1
+        if step is step_full and tot[0] == 0 and tot[1] == 0 \
+                and tot[2] == 0:
+            break
+    return merge_shards(stacked, met_s, return_part=True)
+
+
+def grouped_adapt(mesh: Mesh, met, target_size: int, niter: int = 3,
+                  cycles: int = 12, verbose: int = 0, stats=None,
+                  noinsert: bool = False, noswap: bool = False,
+                  nomove: bool = False, hausd: float | None = None,
+                  ifc_layers: int = 2):
+    """The two-level outer loop on one device: grouped passes with
+    interface displacement between them (the rank-level loop of
+    libparmmg1.c:636-948 collapsed onto one device, groups as the only
+    level).  Engaged by the driver when ``-mesh-size`` yields >= 2
+    groups."""
+    from .partition import move_interfaces
+    from ..core.mesh import mesh_to_host
+
+    part = None
+    for it in range(max(1, niter)):
+        ne = int(np.asarray(mesh.tmask).sum())
+        # a displaced partition fixes the group count (its labels index
+        # the previous split); fresh iterations re-derive it from ne
+        ngroups = (int(part.max()) + 1) if part is not None \
+            else how_many_groups(ne, target_size)
+        if ngroups < 2:
+            from ..ops.adapt import adapt_mesh
+            mesh, met, st = adapt_mesh(
+                mesh, met, verbose=verbose, noinsert=noinsert,
+                noswap=noswap, nomove=nomove, hausd=hausd)
+            if stats is not None:
+                stats += st
+            part = None
+            continue
+        mesh, met, part_m = grouped_adapt_pass(
+            mesh, met, ngroups, cycles=cycles, part=part,
+            verbose=verbose, stats=stats, noinsert=noinsert,
+            noswap=noswap, nomove=nomove, hausd=hausd)
+        if it + 1 < max(1, niter):
+            _, tet_h, _, _, _ = mesh_to_host(mesh)
+            part = move_interfaces(tet_h, part_m, ngroups,
+                                   nlayers=ifc_layers)
+    return mesh, met

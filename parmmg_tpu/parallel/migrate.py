@@ -1,0 +1,677 @@
+"""Incremental shard-to-shard interface migration — no whole-mesh merge.
+
+The reference displaces group interfaces between outer iterations by moving
+only the affected groups over the wire with third-party communicator repair
+(``PMMG_part_moveInterfaces`` moveinterfaces_pmmg.c:1306,
+``PMMG_transfer_all_grps`` distributegrps_pmmg.c:1631-1841, wire format
+mpipack_pmmg.c:1067).  The round-1 TPU path instead merged ALL shards into a
+global host mesh and re-split from scratch every outer iteration — correct,
+but O(global mesh) host round trips per iteration.
+
+This module is the TPU-native replacement:
+
+- **labels on device**: the advancing-front flood (bigger shard's color
+  invades the smaller across the frozen interface, ``nlayers`` tet-ball
+  waves — PMMG_get_ifcDirection/PMMG_mark_boulevolp semantics) runs as a
+  jitted, vmapped program over the stacked shard axis.  The only cross-shard
+  information it needs — which shards share each interface vertex and their
+  sizes — is already static in the comm tables, so the flood needs no
+  collective at all: one scatter-max seeds neighbor priorities at interface
+  vertices, then each wave is a gather/scatter pair.
+- **data movement O(band)**: only the tets/vertices of the displaced
+  interface band travel host<->device; shard buffers are updated in place
+  by sparse scatters (slot ids are stable, the high-watermark allocator of
+  the waves never reuses freed slots).  No global mesh is materialized;
+  the per-shard host views used to rebuild the interface are the same pull
+  the cross-shard analysis refresh already pays.
+- **identity by global id**: vertices are welded across shards by the
+  session's persistent global numbering (split-time ids extended with fresh
+  ids for adapt-created vertices) — the exact-match analogue of the
+  reference's global node numbering (libparmmg.c:923), more robust than
+  coordinate matching.
+- **freeze/unfreeze in place**: entities that leave the interface drop the
+  ``MG_PARBDY|MG_BDY|MG_REQ|MG_NOSURF`` freeze (keeping true-boundary via
+  ``MG_PARBDYBDY`` and user-required via ``MG_REQ`` without ``MG_NOSURF`` —
+  tag_pmmg.c:126-207 untag semantics) and gain ``MG_OLDPARBDY`` (the
+  reference's marker for update_analys / load-balancing weights,
+  tag_pmmg.c:211); entities that join the interface get the freeze
+  (tag_pmmg.c:39-124).
+
+Known deviations from the reference (documented, not hidden): no
+contiguity/reachability repair on the displaced partition (the flood
+advances a connected front, which keeps parts connected in practice;
+the merged-path partitioner still runs ``fix_contiguity``), and the
+donor floor ``ne_min`` keeps an arbitrary prefix of moves rather than the
+reference's first-come order.
+"""
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.mesh import Mesh
+from ..core.constants import (
+    IDIR, IARE, FACE_EDGES, MG_BDY, MG_REQ, MG_NOSURF, MG_PARBDY,
+    MG_PARBDYBDY, MG_OLDPARBDY, PARBDY_TAGS)
+from .comms import InterfaceComms
+
+
+# ---------------------------------------------------------------------------
+# device-side advancing-front labels
+# ---------------------------------------------------------------------------
+_SIZE_CLAMP = 1 << 22   # priority = min(size, clamp) * S + color stays int32
+
+
+def _flood_one(tet, tmask, vmask, node_idx, nbr, sizes, me, n_shards: int,
+               nlayers: int):
+    """Per-shard advancing-front labels (vmapped over the stacked axis).
+
+    Priority of color c = (tet count of shard c, c) lexicographic, packed
+    into one int32 (sizes clamped at 2^22 — beyond that 'bigger' is a tie
+    and the id breaks it, which matches the reference's intent).  A tet
+    flips to the strongest color seen at its corners when that color beats
+    its own; flipped tets push their color to their corners — one tet-ball
+    layer per wave, exactly ``PMMG_part_moveInterfaces``'s front advance.
+    """
+    capP = vmask.shape[0]
+    S = n_shards
+
+    def pri_of(c):
+        return jnp.minimum(sizes[jnp.clip(c, 0, S - 1)], _SIZE_CLAMP) * S + c
+
+    vpri = jnp.where(vmask, pri_of(me), -1)
+    # seed: every interface vertex sees the priorities of the OTHER shards
+    # that share it — static knowledge from the node comm tables
+    idx = node_idx.reshape(-1)
+    nb_pri = jnp.repeat(jnp.where(nbr >= 0, pri_of(nbr), -1),
+                        node_idx.shape[1])
+    safe = jnp.where((idx >= 0) & (nb_pri >= 0), idx, capP)
+    vpri = vpri.at[safe].max(nb_pri, mode="drop")
+
+    label = jnp.full(tet.shape[0], me, jnp.int32)
+
+    def wave(_, carry):
+        vpri, label = carry
+        corner = vpri[jnp.clip(tet, 0, capP - 1)]            # [T,4]
+        tp = jnp.max(corner, axis=1)
+        better = tmask & (tp > pri_of(label))
+        label = jnp.where(better, (tp % S).astype(jnp.int32), label)
+        # propagate the flipped color to the tet's corners
+        lp = jnp.where(tmask, pri_of(label), -1)
+        tgt = jnp.where(tmask[:, None], tet, capP).reshape(-1)
+        vpri = vpri.at[tgt].max(jnp.repeat(lp, 4), mode="drop")
+        return vpri, label
+
+    _, label = jax.lax.fori_loop(0, nlayers, wave, (vpri, label))
+    return label
+
+
+@partial(jax.jit, static_argnames=("n_shards", "nlayers"))
+def flood_labels(stacked: Mesh, node_idx, nbr, sizes, n_shards: int,
+                 nlayers: int = 2):
+    """[S, capT] int32 target-shard label per tet (garbage on dead slots)."""
+    me = jnp.arange(n_shards, dtype=jnp.int32)
+    return jax.vmap(
+        lambda t, tm, vm, ni, nb, m: _flood_one(
+            t, tm, vm, ni, nb, sizes, m, n_shards, nlayers)
+    )(stacked.tet, stacked.tmask, stacked.vmask, node_idx, nbr, me)
+
+
+# ---------------------------------------------------------------------------
+# freeze / unfreeze tag semantics (numpy, applied to selected slots)
+# ---------------------------------------------------------------------------
+def _freeze_bits(tags: np.ndarray, is_edge_or_vert: bool) -> np.ndarray:
+    """Interface freeze (split_to_shards contract; tag_pmmg.c:39-124)."""
+    out = tags.copy()
+    user_req = (out & MG_REQ) != 0
+    true_bdy = (out & MG_BDY) != 0
+    out |= PARBDY_TAGS
+    if is_edge_or_vert:
+        out[true_bdy] |= MG_PARBDYBDY
+    out[user_req] &= ~np.uint32(MG_NOSURF)
+    return out
+
+
+def _unfreeze_bits(tags: np.ndarray, is_edge_or_vert: bool) -> np.ndarray:
+    """Drop the freeze from entities leaving the interface (merge_shards /
+    PMMG_updateTag untag contract, tag_pmmg.c:126-207) + mark
+    ``MG_OLDPARBDY`` (resetOldTag role, tag_pmmg.c:211)."""
+    out = tags.copy()
+    was_ifc = (out & MG_PARBDY) != 0
+    user_req = was_ifc & ((out & MG_NOSURF) == 0) & ((out & MG_REQ) != 0)
+    true_bdy = was_ifc & ((out & MG_PARBDYBDY) != 0)
+    out[was_ifc] &= ~np.uint32(PARBDY_TAGS | MG_PARBDYBDY)
+    if is_edge_or_vert:
+        out[true_bdy] |= MG_BDY
+    out[user_req] |= MG_REQ
+    out[was_ifc] |= MG_OLDPARBDY
+    return out
+
+
+# ---------------------------------------------------------------------------
+# host mirrors
+# ---------------------------------------------------------------------------
+@dataclasses.dataclass
+class ShardViews:
+    """Per-outer-iteration host views of the stacked shards (one pull)."""
+    vert: np.ndarray    # [S, capP, 3]
+    vtag: np.ndarray
+    vref: np.ndarray
+    vmask: np.ndarray
+    tet: np.ndarray     # [S, capT, 4]
+    tref: np.ndarray
+    tmask: np.ndarray
+    ftag: np.ndarray    # [S, capT, 4]
+    fref: np.ndarray
+    etag: np.ndarray    # [S, capT, 6]
+    met: np.ndarray     # [S, capP(, 6)]
+    npoin: np.ndarray   # [S]
+    nelem: np.ndarray   # [S]
+
+
+def pull_views(stacked: Mesh, met_s) -> ShardViews:
+    """One consolidated device->host transfer of the shard state."""
+    h, m = jax.device_get((stacked, met_s))
+    # np.array (copy) everywhere: device_get may hand back READ-ONLY
+    # views of the device buffer, and migration mutates every field
+    return ShardViews(
+        vert=np.array(h.vert), vtag=np.array(h.vtag),
+        vref=np.array(h.vref), vmask=np.array(h.vmask),
+        tet=np.array(h.tet), tref=np.array(h.tref),
+        tmask=np.array(h.tmask), ftag=np.array(h.ftag),
+        fref=np.array(h.fref), etag=np.array(h.etag),
+        met=np.array(m), npoin=np.array(h.npoin), nelem=np.array(h.nelem))
+
+
+def extend_global_ids(glo: list[np.ndarray], views: ShardViews, top: int):
+    """Fresh global ids for adapt-created vertices (shard-private by the
+    freeze contract, so a disjoint id block per shard is exact)."""
+    for s, g in enumerate(glo):
+        fresh = views.vmask[s] & (g < 0)
+        n = int(fresh.sum())
+        if n:
+            g[fresh] = top + np.arange(n, dtype=np.int64)
+            top += n
+        dead = ~views.vmask[s]
+        g[dead] = -1
+    return top
+
+
+# ---------------------------------------------------------------------------
+# interface recomputation from per-shard views (global-id matching)
+# ---------------------------------------------------------------------------
+def _shard_face_table(tet_live: np.ndarray, slots_live: np.ndarray,
+                      glo_s: np.ndarray):
+    """(sorted-global-triple keys, 4*tetslot+face) for every face of the
+    shard's live tets; plus an 'exposed' mask (face unmatched in-shard)."""
+    nt = len(tet_live)
+    if nt == 0:
+        return (np.zeros((0, 3), np.int64), np.zeros(0, np.int64),
+                np.zeros(0, bool))
+    gtet = glo_s[tet_live]                                  # [nt,4] global
+    tri = np.sort(gtet[:, IDIR], axis=2).reshape(nt * 4, 3)  # [4nt,3]
+    slot4 = (4 * slots_live[:, None] +
+             np.arange(4)[None, :]).reshape(-1)
+    order = np.lexsort((tri[:, 2], tri[:, 1], tri[:, 0]))
+    ts = tri[order]
+    same_next = np.concatenate([(ts[1:] == ts[:-1]).all(1), [False]])
+    same_prev = np.concatenate([[False], same_next[:-1]])
+    exposed = np.empty(nt * 4, bool)
+    exposed[order] = ~(same_next | same_prev)
+    return tri, slot4, exposed
+
+
+def recompute_interface(views: ShardViews, glo: list[np.ndarray],
+                        n_shards: int):
+    """Match exposed faces across shards by global key; derive shared
+    vertices from global-id incidence.  Returns
+    (face_lists[a][b] local 4*tet_slot+face ordered by key,
+     node_lists[a][b] local vertex rows ordered by global id,
+     owner[s] [capP] owner shard per local vertex,
+     ifc_face_slots[s] / ifc_vert_rows[s] for retagging)."""
+    S = n_shards
+    keys_all, slots_all, shard_all = [], [], []
+    for s in range(S):
+        live = np.where(views.tmask[s])[0]
+        tri, slot4, exposed = _shard_face_table(
+            views.tet[s][live], live, glo[s])
+        keys_all.append(tri[exposed])
+        slots_all.append(slot4[exposed])
+        shard_all.append(np.full(int(exposed.sum()), s, np.int32))
+    K = np.concatenate(keys_all) if keys_all else np.zeros((0, 3), np.int64)
+    SL = np.concatenate(slots_all)
+    SH = np.concatenate(shard_all)
+    order = np.lexsort((K[:, 2], K[:, 1], K[:, 0]))
+    Ks, SLs, SHs = K[order], SL[order], SH[order]
+    pair = np.concatenate([(Ks[1:] == Ks[:-1]).all(1), [False]])
+    iA = np.where(pair)[0]
+    iB = iA + 1
+    # conforming mesh: a face key appears in at most 2 shards
+    face_lists = [[[] for _ in range(S)] for _ in range(S)]
+    ifc_face_slots = [[] for _ in range(S)]
+    for a, b, sa, sb in zip(SHs[iA], SHs[iB], SLs[iA], SLs[iB]):
+        a, b = int(a), int(b)
+        face_lists[a][b].append(int(sa))
+        face_lists[b][a].append(int(sb))
+        ifc_face_slots[a].append(int(sa))
+        ifc_face_slots[b].append(int(sb))
+
+    # shared vertices by global-id incidence of live vertex sets
+    live_g = [glo[s][views.vmask[s]] for s in range(S)]
+    live_l = [np.where(views.vmask[s])[0] for s in range(S)]
+    allg = np.concatenate(live_g) if live_g else np.zeros(0, np.int64)
+    alls = np.concatenate([np.full(len(g), s, np.int32)
+                           for s, g in enumerate(live_g)])
+    alll = np.concatenate(live_l) if live_l else np.zeros(0, np.int64)
+    o = np.argsort(allg, kind="stable")
+    gs, ss, ls = allg[o], alls[o], alll[o]
+    head = np.concatenate([[True], gs[1:] != gs[:-1]])
+    seg = np.cumsum(head) - 1
+    cnt = np.bincount(seg)
+    shared_seg = cnt > 1
+    node_lists = [[[] for _ in range(S)] for _ in range(S)]
+    owner = [np.full(views.vmask[s].shape[0], s, np.int32)
+             for s in range(S)]
+    ifc_vert_rows = [[] for _ in range(S)]
+    # group rows of each shared vertex (gs sorted, so contiguous)
+    bounds = np.where(head)[0]
+    for b0 in np.where(shared_seg)[0]:
+        lo = bounds[b0]
+        hi = lo + cnt[b0]
+        shards_here = ss[lo:hi]
+        locals_here = ls[lo:hi]
+        own = int(shards_here.max())
+        for s_, l_ in zip(shards_here, locals_here):
+            owner[int(s_)][int(l_)] = own
+            ifc_vert_rows[int(s_)].append(int(l_))
+        for i in range(len(shards_here)):
+            for j in range(len(shards_here)):
+                if shards_here[i] < shards_here[j]:
+                    a, b = int(shards_here[i]), int(shards_here[j])
+                    node_lists[a][b].append(int(locals_here[i]))
+                    node_lists[b][a].append(int(locals_here[j]))
+    # node lists are built in ascending-global-id order because the
+    # shared-vertex loop walks the sorted segment array — the A.4
+    # ordering contract holds by construction
+    return face_lists, node_lists, owner, ifc_face_slots, ifc_vert_rows
+
+
+def comms_from_lists(face_lists, node_lists, owner,
+                     n_shards: int) -> InterfaceComms:
+    """Pad pair item lists into the device-ready comm tables — delegates
+    to the single padding implementation (comms.pad_comm_tables)."""
+    from .comms import pad_comm_tables
+    return pad_comm_tables(node_lists, face_lists, owner, n_shards)
+
+
+# ---------------------------------------------------------------------------
+# the migration step
+# ---------------------------------------------------------------------------
+def enforce_ne_min(labels: np.ndarray, tmask: np.ndarray, n_shards: int,
+                   ne_min: int | None = None) -> np.ndarray:
+    """Donor floor: a shard keeps at least ne_min tets
+    (moveinterfaces_pmmg.c:1343 semantics, min(6, ne/2+1) scaled)."""
+    S = n_shards
+    lab = labels.copy()
+    for s in range(S):
+        live = tmask[s]
+        n = int(live.sum())
+        floor = ne_min if ne_min is not None else min(6, n // 2 + 1)
+        moved = np.where(live & (lab[s] != s))[0]
+        excess = len(moved) - (n - floor)
+        if excess > 0:
+            lab[s][moved[len(moved) - excess:]] = s
+    return lab
+
+
+def migrate_shards(stacked: Mesh, met_s, views: ShardViews,
+                   glo: list[np.ndarray], labels: np.ndarray,
+                   n_shards: int, verbose: int = 0):
+    """Apply the displaced partition: move labeled tet bands between
+    shards, weld by global id, refreeze the new interface, rebuild comms.
+
+    Mutates ``views`` and ``glo`` in place (they are this iteration's host
+    mirrors); returns (stacked, met_s, comms, nmoved).  Device updates are
+    sparse scatters (O(band + interface) transferred), buffer slot ids are
+    stable, and no global mesh is ever materialized — the incremental
+    replacement for merge->repartition->resplit
+    (PMMG_transfer_all_grps role, distributegrps_pmmg.c:1631-1841).
+
+    Phase structure (a shard can be donor AND recipient, so all sender
+    data is extracted before any mirror is mutated):
+      A. extract band packages (pure reads),
+      B. capacity check (+ slot-stable device grow if needed),
+      C. removals + arrivals on the mirrors,
+      D. interface recomputation + freeze/unfreeze retag,
+      E. one sparse push to the device.
+    """
+    S = n_shards
+
+    # ---------- collect moves per (src -> dst) ---------------------------
+    moves = []            # (src, dst, src_tet_slots)
+    nmoved = 0
+    for s in range(S):
+        m = views.tmask[s] & (labels[s] != s)
+        if not m.any():
+            continue
+        for r in np.unique(labels[s][m]):
+            slots = np.where(m & (labels[s] == int(r)))[0]
+            moves.append((s, int(r), slots))
+            nmoved += len(slots)
+    if nmoved == 0:
+        return stacked, met_s, None, 0
+
+    # ---------- A. extract packages (before any mutation) ----------------
+    # per destination r: stacked arrays of arriving tets + a global-id ->
+    # (vert, vtag, vref, met) vertex bank from the senders
+    pkg = {}
+    for s, r, slots in moves:
+        p = pkg.setdefault(r, dict(gt=[], tref=[], ftag=[], fref=[],
+                                   etag=[], bank={}))
+        gt = glo[s][views.tet[s][slots]]                   # [k,4] global
+        p["gt"].append(gt)
+        p["tref"].append(views.tref[s][slots].copy())
+        p["ftag"].append(views.ftag[s][slots].copy())
+        p["fref"].append(views.fref[s][slots].copy())
+        p["etag"].append(views.etag[s][slots].copy())
+        uq, first = np.unique(gt.reshape(-1), return_index=True)
+        lrows = views.tet[s][slots].reshape(-1)[first]
+        for gid, lrow in zip(uq, lrows):
+            gid = int(gid)
+            if gid not in p["bank"]:
+                p["bank"][gid] = (views.vert[s][lrow].copy(),
+                                  np.uint32(views.vtag[s][lrow]),
+                                  views.vref[s][lrow],
+                                  views.met[s][lrow].copy())
+
+    # ---------- B. capacity check ----------------------------------------
+    while True:
+        capP = views.vert.shape[1]
+        capT = views.tet.shape[1]
+        need_grow = False
+        for r, p in pkg.items():
+            need_g = np.unique(np.concatenate(p["gt"]).reshape(-1))
+            known = np.isin(need_g, glo[r][glo[r] >= 0])
+            n_new_v = int((~known).sum())
+            free_v = int((glo[r] < 0).sum())
+            arriving_t = sum(len(g) for g in p["gt"])
+            departing_t = int((views.tmask[r] & (labels[r] != r)).sum())
+            free_t = capT - int(views.tmask[r].sum()) + departing_t
+            if n_new_v > free_v or arriving_t > free_t:
+                need_grow = True
+                break
+        if not need_grow:
+            break
+        # slot-stable device grow (zaldy_pmmg.c regrow analogue) + mirror
+        # and label/glo padding; device buffers untouched otherwise
+        from .distribute import grow_shards
+        stacked, met_s = grow_shards(stacked, met_s, 2 * capP, 2 * capT)
+        views.vert = _padP(views.vert, capP)
+        views.vtag = _padP(views.vtag, capP)
+        views.vref = _padP(views.vref, capP)
+        views.vmask = _padP(views.vmask, capP, False)
+        views.met = _padP(views.met, capP)
+        views.tet = _padT(views.tet, capT)
+        views.tref = _padT(views.tref, capT)
+        views.tmask = _padT(views.tmask, capT, False)
+        views.ftag = _padT(views.ftag, capT)
+        views.fref = _padT(views.fref, capT)
+        views.etag = _padT(views.etag, capT)
+        labels = np.concatenate(
+            [labels, np.zeros((S, capT), labels.dtype)], axis=1)
+        for s in range(S):
+            glo[s] = np.concatenate([glo[s], np.full(capP, -1, np.int64)])
+    capP = views.vert.shape[1]
+
+    # device update accumulators
+    upd_v = {s: [] for s in range(S)}    # (rows, vert, vtag, vref, met)
+    upd_t = {s: [] for s in range(S)}    # (rows, tet, tref, ftag, fref, etag)
+    mask_dirty = set()
+
+    # ---------- C1. removals ---------------------------------------------
+    for s, r, slots in moves:
+        views.tmask[s][slots] = False
+        mask_dirty.add(s)
+
+    # ---------- C2. arrivals ---------------------------------------------
+    for r, p in pkg.items():
+        gt_all = np.concatenate(p["gt"])
+        need_g = np.unique(gt_all.reshape(-1))
+        # known rows: any slot still holding that global id (including
+        # rows whose tets just left — shared vertices are frozen, so the
+        # slot data is still valid and is simply resurrected)
+        hold = glo[r] >= 0
+        have_g = glo[r][hold]
+        have_l = np.where(hold)[0]
+        o = np.argsort(have_g, kind="stable")
+        have_g, have_l = have_g[o], have_l[o]
+        pos = np.searchsorted(have_g, need_g)
+        pos_c = np.clip(pos, 0, max(0, len(have_g) - 1))
+        known = (have_g[pos_c] == need_g) if len(have_g) \
+            else np.zeros(len(need_g), bool)
+        new_g = need_g[~known]
+        free = np.where(glo[r] < 0)[0]
+        tgt_rows = free[: len(new_g)]
+        lut_g = np.concatenate([have_g, new_g])
+        lut_l = np.concatenate([have_l, tgt_rows])
+        o2 = np.argsort(lut_g, kind="stable")
+        lut_g, lut_l = lut_g[o2], lut_l[o2]
+        if len(new_g):
+            vv = np.stack([p["bank"][int(g_)][0] for g_ in new_g])
+            vt = np.asarray([p["bank"][int(g_)][1] for g_ in new_g],
+                            np.uint32)
+            vr = np.asarray([p["bank"][int(g_)][2] for g_ in new_g])
+            vm = np.stack([p["bank"][int(g_)][3] for g_ in new_g])
+            views.vert[r][tgt_rows] = vv
+            views.vtag[r][tgt_rows] = vt
+            views.vref[r][tgt_rows] = vr
+            views.met[r][tgt_rows] = vm
+            glo[r][tgt_rows] = new_g
+            upd_v[r].append((tgt_rows, vv, vt, vr, vm))
+        # tet rows into free slots
+        k = len(gt_all)
+        tfree = np.where(~views.tmask[r])[0]
+        t_rows = tfree[:k]
+        lt = lut_l[np.searchsorted(lut_g, gt_all.reshape(-1))]\
+            .reshape(-1, 4).astype(np.int32)
+        tr_ = np.concatenate(p["tref"])
+        ftg = np.concatenate(p["ftag"])
+        frf = np.concatenate(p["fref"])
+        etg = np.concatenate(p["etag"])
+        views.tet[r][t_rows] = lt
+        views.tref[r][t_rows] = tr_
+        views.ftag[r][t_rows] = ftg
+        views.fref[r][t_rows] = frf
+        views.etag[r][t_rows] = etg
+        views.tmask[r][t_rows] = True
+        mask_dirty.add(r)
+        upd_t[r].append((t_rows, lt, tr_, ftg, frf, etg))
+
+    # ---------- C3. final vertex liveness + watermarks -------------------
+    for s in range(S):
+        live = views.tet[s][views.tmask[s]]
+        ref = np.zeros(capP, bool)
+        if len(live):
+            ref[live.reshape(-1)] = True
+        if not np.array_equal(ref, views.vmask[s]):
+            mask_dirty.add(s)
+        views.vmask[s] = ref
+        glo[s][~ref] = -1          # dead rows become allocatable again
+        used_v = np.where(ref)[0]
+        used_t = np.where(views.tmask[s])[0]
+        views.npoin[s] = (used_v.max() + 1) if len(used_v) else 0
+        views.nelem[s] = (used_t.max() + 1) if len(used_t) else 0
+
+    # ---------- D. recompute the interface + retag -----------------------
+    face_lists, node_lists, owner, ifc_face_slots, ifc_vert_rows = \
+        recompute_interface(views, glo, S)
+    tag_updates = _retag_interfaces(views, glo, ifc_face_slots,
+                                    ifc_vert_rows, S)
+    comms = comms_from_lists(face_lists, node_lists, owner, S)
+
+    # ---------- E. one sparse push to the device -------------------------
+    stacked, met_s = _push_updates(stacked, met_s, views, upd_v, upd_t,
+                                   mask_dirty, tag_updates, S)
+    if verbose >= 2:
+        print(f"  migration: moved {nmoved} tets across "
+              f"{len(moves)} shard pairs")
+    return stacked, met_s, comms, nmoved
+
+
+def _padP(a, n, fill=0):
+    pad = [(0, 0)] * a.ndim
+    pad[1] = (0, n)
+    return np.pad(a, pad, constant_values=fill)
+
+
+_padT = _padP
+
+
+def _retag_interfaces(views: ShardViews, glo, ifc_face_slots,
+                      ifc_vert_rows, S):
+    """Per shard, reconcile freeze tags with the NEW interface: unfreeze
+    entities that left it, freeze entities that joined.  Membership is
+    decided at the geometric-entity level (global keys) and applied to
+    every local slot of the entity.  Returns per-shard sparse updates
+    {(field): (rows..., values)} and mutates the views."""
+    out = []
+    for s in range(S):
+        tm = views.tmask[s]
+        live = np.where(tm)[0]
+        upd = {}
+        # ---- faces ----
+        ft = views.ftag[s]
+        slot_ifc = np.zeros((views.tet.shape[1], 4), bool)
+        if ifc_face_slots[s]:
+            sl = np.asarray(ifc_face_slots[s], np.int64)
+            slot_ifc[sl // 4, sl % 4] = True
+        cur_ifc = (ft & MG_PARBDY) != 0
+        cur_ifc[~tm] = False
+        to_unfreeze = cur_ifc & ~slot_ifc
+        to_freeze = slot_ifc & ~cur_ifc
+        # interface faces carried by BOTH member slots of a face pair:
+        # freeze/unfreeze applies per slot, each side listed separately
+        ftr, ftc = np.where(to_unfreeze | to_freeze)
+        if len(ftr):
+            vals = ft[ftr, ftc].copy()
+            un = to_unfreeze[ftr, ftc]
+            vals[un] = _unfreeze_bits(vals[un], False)
+            vals[~un] = _freeze_bits(vals[~un], False)
+            ft[ftr, ftc] = vals
+            upd["ftag"] = (ftr, ftc, vals)
+        # ---- edges ----
+        # global interface edge keys = edges of the new interface faces
+        et = views.etag[s]
+        g = glo[s]
+        if ifc_face_slots[s]:
+            sl = np.asarray(ifc_face_slots[s], np.int64)
+            tri = np.sort(g[views.tet[s][sl // 4]][
+                np.arange(len(sl))[:, None], IDIR[sl % 4]], axis=1)
+            ek = np.concatenate([
+                tri[:, [0, 1]], tri[:, [0, 2]], tri[:, [1, 2]]])
+            ekey = np.unique(ek[:, 0] * (1 << 31) + ek[:, 1])
+        else:
+            ekey = np.zeros(0, np.int64)
+        gtet = g[views.tet[s]]
+        ev = np.sort(gtet[:, IARE], axis=2)             # [T,6,2]
+        slot_key = ev[..., 0] * (1 << 31) + ev[..., 1]
+        in_new = np.zeros(slot_key.shape, bool)
+        if len(ekey):
+            p = np.searchsorted(ekey, slot_key)
+            pc = np.clip(p, 0, len(ekey) - 1)
+            in_new = ekey[pc] == slot_key
+        in_new[~tm] = False
+        cur = (et & MG_PARBDY) != 0
+        cur[~tm] = False
+        eu = cur & ~in_new
+        ef = in_new & ~cur
+        er, ec = np.where(eu | ef)
+        if len(er):
+            vals = et[er, ec].copy()
+            un = eu[er, ec]
+            vals[un] = _unfreeze_bits(vals[un], True)
+            vals[~un] = _freeze_bits(vals[~un], True)
+            et[er, ec] = vals
+            upd["etag"] = (er, ec, vals)
+        # ---- vertices ----
+        vt = views.vtag[s]
+        new_ifc_v = np.zeros(len(vt), bool)
+        if ifc_vert_rows[s]:
+            new_ifc_v[np.asarray(ifc_vert_rows[s], np.int64)] = True
+        curv = (vt & MG_PARBDY) != 0
+        curv[~views.vmask[s]] = False
+        vu = curv & ~new_ifc_v
+        vf = new_ifc_v & ~curv
+        vr = np.where(vu | vf)[0]
+        if len(vr):
+            vals = vt[vr].copy()
+            un = vu[vr]
+            vals[un] = _unfreeze_bits(vals[un], True)
+            vals[~un] = _freeze_bits(vals[~un], True)
+            vt[vr] = vals
+            upd["vtag"] = (vr, vals)
+        out.append(upd)
+    return out
+
+
+def _push_updates(stacked: Mesh, met_s, views: ShardViews, upd_v, upd_t,
+                  mask_dirty, tag_updates, S):
+    """Apply the collected sparse updates to the device-resident stacked
+    shards.  Transfers are O(band + interface) for the data arrays (the
+    validity masks of touched shards go up whole — they are 1-byte bools,
+    negligible next to one tet row); full-array traffic never leaves the
+    device."""
+    vert_d, vtag_d, vref_d, vmask_d = (stacked.vert, stacked.vtag,
+                                       stacked.vref, stacked.vmask)
+    tet_d, tref_d, tmask_d = stacked.tet, stacked.tref, stacked.tmask
+    ftag_d, fref_d, etag_d = stacked.ftag, stacked.fref, stacked.etag
+    met_d = met_s
+    for s in range(S):
+        for rows, vv, vt, vr_, vm in upd_v[s]:
+            r = jnp.asarray(rows)
+            vert_d = vert_d.at[s, r].set(jnp.asarray(vv, vert_d.dtype))
+            vtag_d = vtag_d.at[s, r].set(jnp.asarray(vt))
+            vref_d = vref_d.at[s, r].set(jnp.asarray(vr_))
+            met_d = met_d.at[s, r].set(jnp.asarray(vm, met_d.dtype))
+        for rows, lt, tr_, ftg, frf, etg in upd_t[s]:
+            r = jnp.asarray(rows)
+            tet_d = tet_d.at[s, r].set(jnp.asarray(lt))
+            tref_d = tref_d.at[s, r].set(jnp.asarray(tr_))
+            ftag_d = ftag_d.at[s, r].set(jnp.asarray(ftg))
+            fref_d = fref_d.at[s, r].set(jnp.asarray(frf))
+            etag_d = etag_d.at[s, r].set(jnp.asarray(etg))
+        if s in mask_dirty:
+            vmask_d = vmask_d.at[s].set(jnp.asarray(views.vmask[s]))
+            tmask_d = tmask_d.at[s].set(jnp.asarray(views.tmask[s]))
+        upd = tag_updates[s]
+        if "ftag" in upd:
+            ftr, ftc, vals = upd["ftag"]
+            ftag_d = ftag_d.at[s, jnp.asarray(ftr), jnp.asarray(ftc)].set(
+                jnp.asarray(vals))
+        if "etag" in upd:
+            er, ec, vals = upd["etag"]
+            etag_d = etag_d.at[s, jnp.asarray(er), jnp.asarray(ec)].set(
+                jnp.asarray(vals))
+        if "vtag" in upd:
+            vr_, vals = upd["vtag"]
+            vtag_d = vtag_d.at[s, jnp.asarray(vr_)].set(jnp.asarray(vals))
+    npoin = jnp.asarray(views.npoin.astype(np.int32))
+    nelem = jnp.asarray(views.nelem.astype(np.int32))
+    out = dataclasses.replace(
+        stacked, vert=vert_d, vtag=vtag_d, vref=vref_d, vmask=vmask_d,
+        tet=tet_d, tref=tref_d, tmask=tmask_d, ftag=ftag_d, fref=fref_d,
+        etag=etag_d, npoin=npoin, nelem=nelem)
+    return out, met_d
+
+
+@jax.jit
+def rebuild_shards(stacked: Mesh) -> Mesh:
+    """Per-shard adjacency + boundary-tag propagation after migration
+    (vmapped build_adjacency; the MMG3D_hashTetra re-hash analogue)."""
+    from ..ops.adjacency import build_adjacency, boundary_edge_tags
+    return jax.vmap(lambda m: boundary_edge_tags(build_adjacency(m)))(
+        stacked)

@@ -1,0 +1,117 @@
+"""Multi-host SPMD backend (jax.distributed over ICI/DCN).
+
+The reference scales across nodes with MPI ranks (mpi_pmmg.h; rank
+discovery + shared-memory budget split in zaldy_pmmg.c:53-96).  The
+JAX-native equivalent is ``jax.distributed.initialize``: each host
+process owns its local TPU devices, ``jax.devices()`` becomes the GLOBAL
+device list, and the same ``shard_map`` programs of parallel/dist.py run
+unchanged — XLA lowers the 'shard' axis collectives onto ICI within a
+pod slice and DCN across slices.
+
+What runs multi-host today:
+- the SPMD adapt cycles (`dist_adapt_cycle`), quality reductions
+  (`dist_quality`) and the on-device interface echo — their inputs are
+  built with :func:`shard_stacked_global`, which feeds each process only
+  its addressable shards (``jax.make_array_from_single_device_arrays``);
+- every process executes the identical host driver (single-program
+  multiple-data at the Python level too — the reference's "all ranks
+  agree via Allreduce" idiom maps to every process computing the same
+  host decisions from the same replicated scalars).
+
+What stays single-host: the host-side orchestration that materializes
+per-shard numpy views (split, merge, migration packaging, analysis
+refresh) currently runs on process 0's data layout and asserts
+single-process when invoked multi-host — distributing those host stages
+across processes is the designed next step (each process already only
+needs ITS shards' views; the package exchange maps to a DCN
+all-to-all).
+
+This module is exercised in CI only in its single-process degenerate
+form (the image has one host); the multi-process paths follow the
+documented jax.distributed contract.
+"""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+
+def init_multihost(coordinator: str | None = None,
+                   num_processes: int | None = None,
+                   process_id: int | None = None) -> bool:
+    """Initialize jax.distributed from args or the standard env vars
+    (JAX_COORDINATOR_ADDRESS / JAX_NUM_PROCESSES / JAX_PROCESS_ID).
+
+    Returns True if a multi-process runtime was initialized; False for
+    the single-process degenerate case (no-op — the NP=1 column of the
+    reference CI matrix).  Safe to call twice.
+    """
+    import jax
+
+    coordinator = coordinator or os.environ.get("JAX_COORDINATOR_ADDRESS")
+    if num_processes is None:
+        num_processes = int(os.environ.get("JAX_NUM_PROCESSES", "1"))
+    if process_id is None:
+        process_id = int(os.environ.get("JAX_PROCESS_ID", "0"))
+    if not coordinator or num_processes <= 1:
+        return False
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator,
+            num_processes=num_processes,
+            process_id=process_id)
+    except RuntimeError as e:
+        if "already initialized" in str(e).lower():
+            return True
+        raise
+    return True
+
+
+def is_multiprocess() -> bool:
+    import jax
+    return jax.process_count() > 1
+
+
+def shard_stacked_global(stacked_host, dmesh):
+    """Place a [D, ...]-stacked HOST pytree onto a (possibly multi-host)
+    device mesh: each process uploads only the shard slices that live on
+    its addressable devices, then the global array is assembled with
+    ``jax.make_array_from_single_device_arrays`` — the multi-host
+    replacement for a plain ``jax.device_put`` (which requires all
+    devices addressable).
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    sh = NamedSharding(dmesh, P("shard"))
+    if jax.process_count() == 1:
+        return jax.tree.map(lambda x: jax.device_put(jnp.asarray(x), sh),
+                            stacked_host)
+
+    devs = list(dmesh.devices.reshape(-1))
+
+    def put(x):
+        x = np.asarray(x)
+        pieces = []
+        for i, d in enumerate(devs):
+            if d.process_index == jax.process_index():
+                pieces.append(jax.device_put(x[i][None], d))
+        return jax.make_array_from_single_device_arrays(
+            x.shape, sh, pieces)
+
+    return jax.tree.map(put, stacked_host)
+
+
+def require_single_process(what: str) -> None:
+    """Guard for host-orchestration stages not yet distributed across
+    processes (split/merge/migration packaging) — fail loudly instead of
+    silently computing on a partial device view."""
+    import jax
+    if jax.process_count() > 1:
+        raise NotImplementedError(
+            f"{what} is single-controller today; run it on one host or "
+            "use the per-process distributed I/O entry "
+            "(io.distributed) — multi-process host orchestration is the "
+            "next step documented in parallel/multihost.py")

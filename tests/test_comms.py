@@ -126,3 +126,23 @@ def test_merge_owner_max():
     recv = jnp.asarray(np.array([[3.0, 1.0, 99.0]]))
     out = merge_owner_max(vals, send_idx, recv)
     assert np.allclose(np.asarray(out), [3.0, 5.0, 2.0, 0.0])
+
+
+def test_multihost_single_process_degenerate():
+    """Multi-host backend helpers in the NP=1 degenerate form (the
+    reference CI always includes NP=1; real multi-process follows the
+    jax.distributed contract, parallel/multihost.py)."""
+    import jax
+    from parmmg_tpu.parallel.multihost import (
+        init_multihost, is_multiprocess, shard_stacked_global,
+        require_single_process)
+    from parmmg_tpu.parallel.dist import make_device_mesh
+
+    assert init_multihost() is False          # no coordinator set
+    assert is_multiprocess() is False
+    require_single_process("test stage")      # must not raise at NP=1
+    dmesh = make_device_mesh(4)
+    x = {"a": np.arange(8, dtype=np.float32).reshape(4, 2)}
+    y = shard_stacked_global(x, dmesh)
+    assert np.allclose(np.asarray(y["a"]), x["a"])
+    assert len(y["a"].sharding.device_set) == 4

@@ -1,0 +1,78 @@
+"""Two-level group decomposition tests (parallel/groups.py).
+
+Reference semantics: ``-mesh-size`` bounds the per-group element count
+(howManyGroups, grpsplit_pmmg.c:47,1589-1614); groups are remeshed with
+their seams frozen, seams are displaced between iterations.  Gates are
+quality/conformity, not exit codes.
+"""
+import numpy as np
+import jax.numpy as jnp
+
+from parmmg_tpu.core.mesh import make_mesh, tet_volumes
+from parmmg_tpu.core import constants as C
+from parmmg_tpu.ops.adjacency import build_adjacency, check_adjacency
+from parmmg_tpu.ops.analysis import analyze_mesh
+from parmmg_tpu.ops.quality import tet_quality
+from parmmg_tpu.parallel.groups import how_many_groups, grouped_adapt
+from parmmg_tpu.utils.fixtures import cube_mesh
+
+
+def test_how_many_groups_clamps():
+    assert how_many_groups(100, 0) == 1
+    assert how_many_groups(100, 1000) == 1
+    assert how_many_groups(1000, 100) == 10
+    assert how_many_groups(10 ** 9, 10) == C.REMESHER_NGRPS_MAX
+
+
+def test_grouped_adapt_conforming():
+    vert, tet = cube_mesh(3)
+    m = make_mesh(vert, tet, capP=4 * len(vert), capT=4 * len(tet))
+    m = analyze_mesh(m).mesh
+    met = jnp.full(m.capP, 0.3, m.vert.dtype)
+    ne = len(tet)
+    out, met2 = grouped_adapt(m, met, target_size=ne // 4, niter=2,
+                              cycles=3)
+    out = build_adjacency(out)
+    assert check_adjacency(out) == {"asymmetric": 0, "face_mismatch": 0}
+    vols = np.asarray(tet_volumes(out))[np.asarray(out.tmask)]
+    assert (vols > 0).all()
+    assert np.isclose(vols.sum(), 1.0, rtol=1e-4)
+    q = np.asarray(tet_quality(out, met2))[np.asarray(out.tmask)]
+    assert q.min() > 0.02
+
+
+def test_mesh_size_engages_groups():
+    """Setting IParam.meshSize below the mesh size must route the
+    single-device run through the grouped path."""
+    from parmmg_tpu.api import ParMesh, IParam
+    from parmmg_tpu.parallel import groups as G
+
+    called = {"n": 0}
+    orig = G.grouped_adapt
+
+    def counting(*a, **k):
+        called["n"] += 1
+        return orig(*a, **k)
+
+    G.grouped_adapt = counting
+    try:
+        vert, tet = cube_mesh(2)
+        pm = ParMesh()
+        pm.set_mesh_size(np_=len(vert), ne=len(tet))
+        pm.set_vertices(vert)
+        pm.set_tetrahedra(tet + 1)
+        pm.set_met_size(1, len(vert))
+        pm.set_scalar_mets(np.full(len(vert), 0.4))
+        pm.set_iparameter(IParam.niter, 1)
+        pm.set_iparameter(IParam.meshSize, len(tet) // 3)
+        assert pm.run() == C.PMMG_SUCCESS
+    finally:
+        G.grouped_adapt = orig
+    assert called["n"] == 1
+    v, _ = pm.get_vertices()
+    t, _ = pm.get_tetrahedra()
+    p = v[t - 1]
+    vol = np.einsum("ti,ti->t", p[:, 1] - p[:, 0],
+                    np.cross(p[:, 2] - p[:, 0], p[:, 3] - p[:, 0])) / 6
+    assert (vol > 0).all()
+    assert np.isclose(vol.sum(), 1.0, rtol=1e-4)

@@ -109,3 +109,57 @@ def test_interpolate_from_background_driver():
     vm = np.asarray(mesh.vmask)
     assert (met2[vm] < 1.0).all()          # overwritten from background
     assert not np.asarray(loc.failed)[vm].any()
+
+
+def test_locate_points_bdy_sphere():
+    """Surface walk localization (PMMG_locatePointBdy analogue): points on
+    a sphere surface must land on a surface triangle whose plane is close,
+    and a surface field (linear in xyz restricted to the surface) must
+    interpolate through the TRIANGLE, not some interior tet."""
+    from parmmg_tpu.ops.interp import locate_points_bdy, interp_p1_tri
+    from parmmg_tpu.ops.analysis import analyze_mesh
+    from parmmg_tpu.utils.fixtures import sphere_mesh
+
+    vert, tet = sphere_mesh(8)
+    m = make_mesh(vert, tet, capP=2 * len(vert), capT=2 * len(tet))
+    m = analyze_mesh(m).mesh
+    rng = np.random.default_rng(3)
+    # query points ON the analytic sphere (radius of the fixture surface
+    # vertices), i.e. slightly OUTSIDE the polyhedral surface
+    vb = vert[np.linalg.norm(vert, axis=1) > 0.6]
+    R = float(np.linalg.norm(vb, axis=1).mean())
+    d = rng.normal(size=(30, 3))
+    pts = (R * d / np.linalg.norm(d, axis=1, keepdims=True)).astype(
+        np.float32)
+    sloc = locate_points_bdy(m, jnp.asarray(pts))
+    # located triangles are real surface slots and the plane distance is
+    # small (chord sagitta scale, not O(R))
+    assert float(jnp.max(jnp.abs(sloc.dist))) < 0.15 * R
+    coef = np.array([0.7, -1.1, 0.4])
+    field = np.asarray(m.vert) @ coef
+    got = np.asarray(interp_p1_tri(jnp.asarray(field), m, sloc))
+    want = pts @ coef
+    # the error budget is the chord sagitta of the COARSE fixture
+    # (|coef| ~ 1.4 x sagitta ~ 0.08R at sphere_mesh(8)); the gate is
+    # that every point interpolates from a genuinely nearby surface
+    # triangle, not some far slot
+    assert np.abs(got - want).max() < 1.5 * 0.15 * R
+
+
+def test_interpolate_from_background_boundary_split():
+    """Boundary vertices must take the surface-interpolated value."""
+    import dataclasses
+    from parmmg_tpu.core.constants import MG_BDY
+    bg = _cube(3)
+    bg_met = jnp.asarray(np.linspace(0.1, 0.5, bg.capP))
+    mesh = _cube(2)
+    met = jnp.full(mesh.capP, 99.0)
+    met2, _, _ = interpolate_from_background(bg, bg_met, mesh, met)
+    vm = np.asarray(mesh.vmask)
+    assert (np.asarray(met2)[vm] < 1.0).all()
+    # the split engages when vtag has MG_BDY (mesh is analyzed in prod)
+    vtag = np.zeros(mesh.capP, np.uint32)
+    vtag[: 4] = MG_BDY
+    mesh_b = dataclasses.replace(mesh, vtag=jnp.asarray(vtag))
+    met3, _, _ = interpolate_from_background(bg, bg_met, mesh_b, met)
+    assert (np.asarray(met3)[vm] < 1.0).all()
