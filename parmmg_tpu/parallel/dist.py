@@ -455,7 +455,8 @@ def distributed_adapt_multi(mesh: Mesh, met, n_shards: int,
     from .distribute import split_to_shards, merge_shards
     from .comms import build_interface_comms
     from .migrate import (pull_views, extend_global_ids, flood_labels,
-                          enforce_ne_min, migrate_shards, rebuild_shards)
+                          enforce_ne_min, migrate_shards, rebuild_shards,
+                          weld_shard_bands)
     from .multihost import require_single_process
 
     # the host orchestration below (split, views pull, migration
@@ -532,11 +533,23 @@ def distributed_adapt_multi(mesh: Mesh, met, n_shards: int,
                 jnp.asarray(comms.nbr), sizes, n_shards,
                 nlayers=ifc_layers))
             labels = enforce_ne_min(labels, views.tmask, n_shards)
+            # destination shards (band recipients) — computed BEFORE the
+            # migration mutates the views/labels shapes
+            touched = sorted({int(r) for s_ in range(n_shards)
+                              for r in np.unique(
+                                  labels[s_][views.tmask[s_]])
+                              if int(r) != s_})
             stacked, met_s, comms2, nmoved = migrate_shards(
                 stacked, met_s, views, glo, labels, n_shards,
                 verbose=verbose)
             if nmoved:
                 comms = comms2
+                # weld near-duplicate pairs now interior to one shard
+                # (the merged path got this from merge_shards every
+                # iteration; see migrate.weld_shard_bands)
+                stacked, _ = weld_shard_bands(
+                    stacked, views, glo, n_shards,
+                    touched=touched, verbose=verbose)
                 stacked = rebuild_shards(stacked)
                 check_interface_echo(stacked, met_s, comms, dmesh,
                                      vert_h)

@@ -32,10 +32,8 @@ This module is the TPU-native replacement:
 - **freeze/unfreeze in place**: entities that leave the interface drop the
   ``MG_PARBDY|MG_BDY|MG_REQ|MG_NOSURF`` freeze (keeping true-boundary via
   ``MG_PARBDYBDY`` and user-required via ``MG_REQ`` without ``MG_NOSURF`` —
-  tag_pmmg.c:126-207 untag semantics) and gain ``MG_OLDPARBDY`` (the
-  reference's marker for update_analys / load-balancing weights,
-  tag_pmmg.c:211); entities that join the interface get the freeze
-  (tag_pmmg.c:39-124).
+  tag_pmmg.c:126-207 untag semantics); entities that join the
+  interface get the freeze (tag_pmmg.c:39-124).
 
 Known deviations from the reference (documented, not hidden): no
 contiguity/reachability repair on the displaced partition (the flood
@@ -56,7 +54,7 @@ import jax.numpy as jnp
 from ..core.mesh import Mesh
 from ..core.constants import (
     IDIR, IARE, FACE_EDGES, MG_BDY, MG_REQ, MG_NOSURF, MG_PARBDY,
-    MG_PARBDYBDY, MG_OLDPARBDY, PARBDY_TAGS)
+    MG_PARBDYBDY, PARBDY_TAGS)
 from .comms import InterfaceComms
 
 
@@ -138,8 +136,17 @@ def _freeze_bits(tags: np.ndarray, is_edge_or_vert: bool) -> np.ndarray:
 
 def _unfreeze_bits(tags: np.ndarray, is_edge_or_vert: bool) -> np.ndarray:
     """Drop the freeze from entities leaving the interface (merge_shards /
-    PMMG_updateTag untag contract, tag_pmmg.c:126-207) + mark
-    ``MG_OLDPARBDY`` (resetOldTag role, tag_pmmg.c:211)."""
+    PMMG_updateTag untag contract, tag_pmmg.c:126-207).
+
+    Deliberately does NOT set ``MG_OLDPARBDY`` (the reference's
+    resetOldTag marker, tag_pmmg.c:211): the reference consumes it to
+    target update_analys and to weight the group graph, but here the
+    analysis refresh is global (refresh_shard_analysis re-derives every
+    classification from the global numbering) and partition weights come
+    from the metric — while a residual bit on formerly-interface
+    faces/edges would poison every 'untagged cavity' guard (repair,
+    weld, swap candidacy) exactly where the band needs remeshing most.
+    """
     out = tags.copy()
     was_ifc = (out & MG_PARBDY) != 0
     user_req = was_ifc & ((out & MG_NOSURF) == 0) & ((out & MG_REQ) != 0)
@@ -148,7 +155,6 @@ def _unfreeze_bits(tags: np.ndarray, is_edge_or_vert: bool) -> np.ndarray:
     if is_edge_or_vert:
         out[true_bdy] |= MG_BDY
     out[user_req] |= MG_REQ
-    out[was_ifc] |= MG_OLDPARBDY
     return out
 
 
@@ -666,6 +672,64 @@ def _push_updates(stacked: Mesh, met_s, views: ShardViews, upd_v, upd_t,
         tet=tet_d, tref=tref_d, tmask=tmask_d, ftag=ftag_d, fref=fref_d,
         etag=etag_d, npoin=npoin, nelem=nelem)
     return out, met_d
+
+
+def weld_shard_bands(stacked: Mesh, views: ShardViews,
+                     glo: list[np.ndarray], n_shards: int,
+                     touched=None, verbose: int = 0):
+    """Sequential near-duplicate weld INSIDE each shard after migration.
+
+    Independent refinement on both sides of a frozen interface leaves
+    near-coincident interior point pairs; once the band migrates, both
+    copies live in ONE shard and deadlock the batched collapse (every
+    parallel contraction inverts a neighbor sliver).  The merged path
+    welds them at every inter-iteration merge (distribute.merge_shards);
+    the shard-resident loop does the same here, per shard, on the host
+    views — only untagged (non-interface) pairs are touched, so the comm
+    tables stay valid.  Returns (stacked, nweld).
+    """
+    from .distribute import _weld_close_pairs
+
+    tet_d = stacked.tet
+    tmask_d = stacked.tmask
+    vmask_d = stacked.vmask
+    ntot = 0
+    for s in (range(n_shards) if touched is None else touched):
+        tm = views.tmask[s]
+        live = np.where(tm)[0]
+        if not len(live):
+            continue
+        tet_live = views.tet[s][live]
+        tet2, vkeep, tkeep = _weld_close_pairs(
+            views.vert[s], tet_live, views.vtag[s], views.met[s],
+            views.tref[s][live], views.ftag[s][live],
+            views.etag[s][live])
+        if vkeep.all() and tkeep.all() and \
+                np.array_equal(tet2, tet_live):
+            continue
+        ntot += int((~vkeep).sum())
+        # apply to the mirrors (slot-stable)
+        views.tet[s][live] = tet2
+        views.tmask[s][live[~tkeep]] = False
+        ref = np.zeros(views.vmask.shape[1], bool)
+        alive = views.tet[s][views.tmask[s]]
+        if len(alive):
+            ref[alive.reshape(-1)] = True
+        views.vmask[s] = ref
+        glo[s][~ref] = -1
+        # sparse device push: changed tet rows + the two masks
+        chg = live[np.any(tet2 != tet_live, axis=1) | ~tkeep]
+        if len(chg):
+            tet_d = tet_d.at[s, jnp.asarray(chg)].set(
+                jnp.asarray(views.tet[s][chg]))
+        tmask_d = tmask_d.at[s].set(jnp.asarray(views.tmask[s]))
+        vmask_d = vmask_d.at[s].set(jnp.asarray(views.vmask[s]))
+    if ntot and verbose >= 2:
+        print(f"  band weld: {ntot} near-duplicate pairs contracted")
+    if ntot == 0:
+        return stacked, 0
+    return dataclasses.replace(stacked, tet=tet_d, tmask=tmask_d,
+                               vmask=vmask_d), ntot
 
 
 @jax.jit
