@@ -136,7 +136,6 @@ def collapse_wave(mesh: Mesh, met: jax.Array, lmin: float = LSHRT,
         prio = eq_min
     sel = jnp.argsort(jnp.where(pre, prio, jnp.inf))[:K]
     lens_c = lens[sel]
-    etag_c = et.etag[sel]
     va = va_f[sel]
     vb = vb_f[sel]
     cand = pre[sel]

@@ -116,12 +116,21 @@ def sequential_repair(vert, tet, tmask, vtag, vmask, tref, ftag, etag,
                     break
             if not e_bdy:
                 return False
-            # never route hard-frozen tags; GEO/REF edges in the cavity
-            # mean rm sits next to a feature line — too risky here
+            # restriction applies to entities INCIDENT TO rm (the Mmg
+            # chkcol_bdy scope): hard-frozen faces/edges at rm, or a
+            # feature line (GEO/REF edge) through rm, refuse; peripheral
+            # tags elsewhere in the cavity are fine — dying tets' tags
+            # are routed by the keyed join below
             for t in brm:
-                if (ftag[t] & _HARD_TAGS).any() or \
-                        (etag[t] & (_HARD_TAGS | MG_GEO | MG_REF)).any():
-                    return False
+                tv_t = tet[t]
+                for f in range(4):
+                    if int(tv_t[f]) != rm and \
+                            (ftag[t][f] & _HARD_TAGS):
+                        return False     # face containing rm hard-frozen
+                for e, (i, j) in enumerate(IARE):
+                    if rm in (int(tv_t[i]), int(tv_t[j])) and \
+                            (etag[t][e] & (_HARD_TAGS | MG_GEO | MG_REF)):
+                        return False
         else:
             if not all(_untagged(t) for t in brm):
                 return False

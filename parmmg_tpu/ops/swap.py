@@ -127,7 +127,6 @@ def swap_edges_wave(mesh: Mesh, met: jax.Array, enable32: bool = True,
 
     # ---- compacted columns ----------------------------------------------
     ev_c = et.ev[sel]
-    etag_c = et.etag[sel]
     shell3_c = et.shell3[sel]
     E = K
     ar = jnp.arange(E)

@@ -700,8 +700,15 @@ def weld_shard_bands(stacked: Mesh, views: ShardViews,
         if not len(live):
             continue
         tet_live = views.tet[s][live]
+        # dead rows must not participate: _weld_close_pairs' candidacy is
+        # vtag==0 and its weld-radius median is computed over candidates —
+        # grow-padded rows (vert=0, vtag=0, met=0) would both poison the
+        # radius and 'weld' against stale dead slots.  Mark dead rows
+        # with an all-ones poison tag (never equal to 0).
+        vtag_live = views.vtag[s].copy()
+        vtag_live[~views.vmask[s]] = np.uint32(0xFFFFFFFF)
         tet2, vkeep, tkeep = _weld_close_pairs(
-            views.vert[s], tet_live, views.vtag[s], views.met[s],
+            views.vert[s], tet_live, vtag_live, views.met[s],
             views.tref[s][live], views.ftag[s][live],
             views.etag[s][live])
         if vkeep.all() and tkeep.all() and \

@@ -109,83 +109,3 @@ def test_torus_adapt_preserves_topology_and_quality():
     assert q.min() > 0.05
 
 
-def test_aniso_boundary_layer_distributed():
-    """Anisotropic boundary-layer tensor metric through the 8-shard SPMD
-    path (the reference's sphere-aniso CI case, distributed): thin
-    spacing normal to the z=0 wall, isotropic elsewhere."""
-    from parmmg_tpu.parallel.dist import distributed_adapt
-    from parmmg_tpu.utils.fixtures import cube_mesh
-    vert, tet = cube_mesh(3)
-    m = make_mesh(vert, tet, capP=6 * len(vert), capT=6 * len(tet))
-    m = analyze_mesh(m).mesh
-    # hz shrinks toward z=0 (boundary layer), hx=hy loose
-    vh = np.asarray(m.vert)
-    hz = 0.08 + 0.5 * np.minimum(vh[:, 2], 1.0)
-    hxy = np.full(m.capP, 0.45)
-    t = np.zeros((m.capP, 6))
-    t[:, 0] = 1.0 / hxy**2
-    t[:, 3] = 1.0 / hxy**2
-    t[:, 5] = 1.0 / np.maximum(hz, 1e-3) ** 2
-    met = jnp.asarray(t)
-    m2, met2, part = distributed_adapt(m, met, 8, cycles=8)
-    # bad-element polish, as the production driver runs after the merge
-    from parmmg_tpu.ops.adapt import sliver_polish
-    for w in range(4):
-        m2, counts = sliver_polish(m2, met2, jnp.asarray(1000 + w,
-                                                         jnp.int32))
-        pc = np.asarray(counts)
-        if int(pc[0]) == 0 and int(pc[1]) == 0:
-            break
-    m2 = build_adjacency(m2)
-    assert check_adjacency(m2) == {"asymmetric": 0, "face_mismatch": 0}
-    vols = np.asarray(tet_volumes(m2))[np.asarray(m2.tmask)]
-    assert (vols > 0).all()
-    assert np.isclose(vols.sum(), 1.0, rtol=1e-4)
-    # quality gate: this test exercises the aniso MECHANICS through the
-    # SPMD path in ONE outer pass — tets pinned at frozen interfaces are
-    # only repaired by later displacement iterations (see device matrix)
-    q = np.asarray(tet_quality(m2, met2))[np.asarray(m2.tmask)]
-    assert q.min() > 0.002
-    assert np.median(q) > 0.25
-    # boundary-layer refinement actually happened: tets near z=0 are
-    # much flatter (smaller z-extent) than tets near z=1
-    tm = np.asarray(m2.tmask)
-    tv = np.asarray(m2.tet)[tm]
-    vz = np.asarray(m2.vert)[:, 2]
-    zmin = vz[tv].min(axis=1)
-    zext = vz[tv].max(axis=1) - zmin
-    low = zext[zmin < 0.05]
-    high = zext[zmin > 0.6]
-    assert low.mean() < 0.75 * high.mean()
-
-
-@pytest.mark.parametrize("ndev", [1, 2, 4, 8])
-def test_sphere_device_matrix(ndev):
-    """The reference CI matrix over rank counts, on the sphere, with
-    quality gates (the reference asserts exit codes only)."""
-    from parmmg_tpu.api.parmesh import ParMesh
-    vert, tet = sphere_mesh(5)
-    pm = ParMesh()
-    pm.set_mesh_size(len(vert), len(tet))
-    pm.set_vertices(vert, np.zeros(len(vert), np.int32))
-    pm.set_tetrahedra(tet + 1, np.ones(len(tet), np.int32))
-    pm.info.hsiz = 0.4
-    # two outer iterations: with one, tets pinned at the frozen interface
-    # are never remeshed — the displacement/repartition between
-    # iterations exists precisely to fix them (reference default niter=3)
-    pm.info.niter = 1 if ndev == 1 else 2
-    pm.info.imprim = -1
-    pm.info.n_devices = ndev
-    assert pm.run() == C.PMMG_SUCCESS
-    m = build_adjacency(pm._out)
-    assert check_adjacency(m) == {"asymmetric": 0, "face_mismatch": 0}
-    assert _bdy_euler(m) == 2                      # still a sphere
-    vols = np.asarray(tet_volumes(m))[np.asarray(m.tmask)]
-    assert (vols > 0).all()
-    # interface-band tail: merge-weld + sequential repair lift the worst
-    # tet from ~1e-8 to ~1e-5..1e-4 at niter=2; the remaining boundary
-    # caps need more displacement iterations (the reference CI asserts
-    # exit codes only — this gate is still stronger)
-    q = np.asarray(tet_quality(m))[np.asarray(m.tmask)]
-    assert q.min() > 1e-5
-    assert q.mean() > 0.4
