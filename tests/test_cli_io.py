@@ -90,9 +90,10 @@ def test_distributed_roundtrip(tmp_path):
     assert nc2[0].global_.tolist() == [50, 60]
 
 
-def test_cli_reads_distributed_input(tmp_path):
-    vert, tet = cube_mesh(2)
-    # split tets in two halves by x-centroid, shared plane duplicated
+def _write_split_cube(tmp_path, n=2):
+    """Two-shard distributed fixture: centroid-split cube halves written
+    as name.<rank>.mesh files; returns (vert, tet, part)."""
+    vert, tet = cube_mesh(n)
     cent = vert[tet].mean(axis=1)
     part = (cent[:, 0] > 0.5).astype(int)
     for r in range(2):
@@ -106,6 +107,11 @@ def test_cli_reads_distributed_input(tmp_path):
         m.tetra = g2l[sel].astype(np.int32)
         m.tref = np.zeros(len(sel), np.int32)
         save_distributed_mesh(tmp_path / "d.mesh", r, m)
+    return vert, tet, part
+
+
+def test_cli_reads_distributed_input(tmp_path):
+    vert, tet, part = _write_split_cube(tmp_path)
     rc = cli_main(["-in", str(tmp_path / "d.mesh"), "-niter", "1",
                    "-noinsert", "-noswap", "-nomove", "-v", "0"])
     assert rc == 0
@@ -189,3 +195,37 @@ def test_cli_distributed_output_multishard_roundtrip(tmp_path):
     back = medit.read_mesh(tmp_path / "back.mesh")
     assert len(back.tetra) == len(tet)
     assert len(back.vert) == len(vert)
+
+
+def test_distributed_input_adopts_partition(tmp_path):
+    """Distributed input stays distributed (libparmmg.c:206-329
+    semantics): the run must ADOPT the caller's decomposition as the
+    initial partition instead of re-partitioning — verified by spying on
+    distributed_adapt_multi's `part` argument."""
+    vert, tet, part = _write_split_cube(tmp_path)
+
+    from parmmg_tpu.parallel import dist as dist_mod
+    seen = {}
+    orig = dist_mod.distributed_adapt_multi
+
+    def spy(mesh, met, n_shards, **kw):
+        seen["part"] = None if kw.get("part") is None \
+            else np.array(kw["part"])
+        return orig(mesh, met, n_shards, **kw)
+
+    dist_mod.distributed_adapt_multi = spy
+    try:
+        rc = cli_main(["-in", str(tmp_path / "d.mesh"), "-niter", "1",
+                       "-noinsert", "-noswap", "-nomove", "-ndev", "2",
+                       "-v", "0"])
+    finally:
+        dist_mod.distributed_adapt_multi = orig
+    assert rc == 0
+    # adopted VERBATIM: the concatenated files list shard 0's tets then
+    # shard 1's, so the adopted labels must be exactly that sequence —
+    # no sort on the spy side (a flipped or scrambled adoption fails)
+    assert seen["part"] is not None
+    n0 = int((part == 0).sum())
+    n1 = int((part == 1).sum())
+    assert np.array_equal(seen["part"],
+                          np.repeat([0, 1], [n0, n1]))
