@@ -89,7 +89,9 @@ class Info:
     nosurf: bool = False
     anisosize: bool = False
     opnbdy: bool = False
-    fem: bool = False
+    # FEM-suitable output by default (MMG5_FEM, API_functions_pmmg.c:413);
+    # -nofem turns it off.  Consumed by driver._finish_run's fem pass.
+    fem: bool = True
     # unsupported-feature knobs, accepted then rejected at run() like the
     # reference's PMMG_check_inputData (libparmmg.c:69-81): level-set
     # discretization and lagrangian motion are settable but refused

@@ -354,9 +354,9 @@ def parmmg_run(pm) -> tuple[Mesh, object, AdaptStats]:
 
 def _finish_run(pm, mesh, met, stats, info, tim, bg_mesh, bg_fields,
                 hausd):
-    """Common run tail: sequential sliver repair, user-field
-    interpolation, reports.  Shared by the whole-mesh, grouped and
-    distributed paths."""
+    """Common run tail: sequential sliver repair, FEM-topology
+    conformity, user-field interpolation, reports.  Shared by the
+    whole-mesh, grouped and distributed paths."""
     # sequential last-resort repair: tangled sliver clusters (stacked
     # near-flat tets, typically born at former frozen interfaces) veto
     # every BATCHED fix — each parallel op inverts a neighbor — while the
@@ -370,6 +370,34 @@ def _finish_run(pm, mesh, met, stats, info, tim, bg_mesh, bg_fields,
                 allow_swap=not info.noswap, allow_move=not info.nomove)
             if nrep and info.imprim >= C.PMMG_VERB_STEPS:
                 print(f"  sequential repair: {nrep} cluster ops")
+
+    # FEM-mode topology fix (default ON like the reference,
+    # API_functions_pmmg.c:413; disabled by -nofem): split interior edges
+    # connecting two boundary points so no element touches the boundary
+    # with two faces / all four vertices (ops.split.split_wave fem_only).
+    # AFTER the repair pass — a repair collapse could otherwise resurrect
+    # a bdy-bdy interior edge the fem pass just removed.
+    if info.fem and not info.noinsert:
+        from .ops.adapt import fem_pass, grow_mesh_met
+        with tim("fem conformity"):
+            nf = 0
+            for _w in range(8):
+                mesh, met, fc = fem_pass(mesh, met)
+                nf, ovf = (int(v) for v in np.asarray(fc))
+                stats.nsplit += nf
+                if ovf:
+                    mesh, met = grow_mesh_met(mesh, met, 2 * mesh.capP,
+                                              2 * mesh.capT)
+                    stats.regrows += 1
+                    continue
+                if nf == 0:
+                    break
+            if nf and info.imprim >= 0:
+                import sys
+                print("  ## Warning: fem conformity pass did not "
+                      f"converge ({nf} edges remain); output may "
+                      "contain elements with two boundary faces.",
+                      file=sys.stderr)
 
     # interpolate user fields old mesh -> new mesh
     if bg_fields:

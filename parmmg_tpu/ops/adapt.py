@@ -60,7 +60,8 @@ def adapt_cycle_impl(mesh: Mesh, met: jax.Array, wave: jax.Array,
                      do_swap: bool = True, do_smooth: bool = True,
                      smooth_waves: int = 1, do_insert: bool = True,
                      final_rebuild: bool = True,
-                     hausd: float | None = None):
+                     hausd: float | None = None,
+                     budget_div: int = 8):
     """One adaptation cycle: split -> collapse -> [swap] -> [smooth].
 
     Pure jittable function (jitted wrapper below) — also the compile-check
@@ -84,11 +85,12 @@ def adapt_cycle_impl(mesh: Mesh, met: jax.Array, wave: jax.Array,
     """
     from .adjacency import boundary_edge_tags
     if do_insert:
-        res = split_wave(mesh, met, hausd=hausd)
+        res = split_wave(mesh, met, hausd=hausd, budget_div=budget_div)
         mesh, met = res.mesh, res.met
         nsplit, overflow = res.nsplit, res.overflow
 
-        col = collapse_wave(mesh, met, hausd=hausd)
+        col = collapse_wave(mesh, met, hausd=hausd,
+                            budget_div=budget_div)
         # collapse rewires the surface (dying tets' face tags transfer to
         # the surviving neighbors); re-propagate MG_BDY from faces to
         # their edges and vertices so later splits/smooth treat the new
@@ -104,9 +106,10 @@ def adapt_cycle_impl(mesh: Mesh, met: jax.Array, wave: jax.Array,
 
     nswap = jnp.zeros((), jnp.int32)
     if do_swap:
-        sew = swap_edges_wave(mesh, met, hausd=hausd)  # 3-2 + 2-2
+        sew = swap_edges_wave(mesh, met, hausd=hausd,
+                              budget_div=budget_div)  # 3-2 + 2-2
         mesh = build_adjacency(sew.mesh)        # consumed by swap23
-        s23 = swap23_wave(mesh, met)
+        s23 = swap23_wave(mesh, met, budget_div=budget_div)
         mesh = s23.mesh
         nswap = sew.nswap + s23.nswap
 
@@ -128,8 +131,28 @@ def adapt_cycle_impl(mesh: Mesh, met: jax.Array, wave: jax.Array,
 
 adapt_cycle = partial(jax.jit, static_argnames=(
     "do_swap", "do_smooth", "smooth_waves", "do_insert", "final_rebuild",
-    "hausd"),
+    "hausd", "budget_div"),
     donate_argnums=(0, 1))(adapt_cycle_impl)
+
+
+def fem_pass_impl(mesh: Mesh, met: jax.Array):
+    """One FEM-conformity wave: split interior edges whose endpoints are
+    both boundary points (the configuration that lets an element touch
+    the boundary with two faces or all four vertices).  This is the
+    Mmg fem-mode topology fix the reference forwards per group
+    (API_functions_pmmg.c:652-658, default ``info.fem`` ON :413); run
+    after the sizing/polish loop until no candidate remains.
+
+    Returns (mesh, met, counts[2] = [nsplit, overflow])."""
+    from .adjacency import boundary_edge_tags
+    res = split_wave(mesh, met, fem_only=True, budget_div=2)
+    mesh = boundary_edge_tags(res.mesh)
+    mesh = build_adjacency(mesh)
+    return mesh, res.met, jnp.stack(
+        [res.nsplit, res.overflow.astype(jnp.int32)])
+
+
+fem_pass = partial(jax.jit, donate_argnums=(0, 1))(fem_pass_impl)
 
 
 def adapt_cycles_fused_impl(mesh: Mesh, met: jax.Array, wave0: jax.Array,
@@ -246,6 +269,7 @@ def adapt_mesh(mesh: Mesh, met: jax.Array, max_cycles: int = 50,
     # re-analysis here would re-introduce MG_GEO tags the user disabled
     mesh = analyze_mesh(mesh, ANGEDG if angedg is None else angedg).mesh
     quiet = 0
+    wide_check = False
     for cycle in range(max_cycles):
         # capacity management before the wave
         n_p, n_t = mesh.np_counts()
@@ -259,7 +283,8 @@ def adapt_mesh(mesh: Mesh, met: jax.Array, max_cycles: int = 50,
             and not noswap
         mesh, met, counts = adapt_cycle(
             mesh, met, jnp.asarray(cycle, jnp.int32), do_swap=do_swap,
-            do_smooth=not nomove, do_insert=not noinsert, hausd=hausd)
+            do_smooth=not nomove, do_insert=not noinsert, hausd=hausd,
+            budget_div=2 if wide_check else 8)
         ns, nc, nw, nm, ovf, _ = (int(v) for v in np.asarray(counts))
         stats.nsplit += ns
         stats.ncollapse += nc
@@ -276,11 +301,25 @@ def adapt_mesh(mesh: Mesh, met: jax.Array, max_cycles: int = 50,
         if ns == 0 and nc == 0 and (noswap or (nw == 0 and do_swap)):
             quiet += 1
             if quiet >= 2 or nm == 0 or nomove:
-                break
+                if wide_check or (noinsert and noswap):
+                    # (with insertions AND swaps disabled no budget-
+                    # governed op runs — a wide cycle cannot differ)
+                    break
+                # Verify convergence at a wider candidate budget before
+                # accepting it: with top-K compaction, candidates that
+                # permanently fail the post-compaction geometric gates
+                # (worst shell quality = always selected) can pin every
+                # budget slot while viable candidates ranked past K are
+                # never attempted — counts==0 would then be starvation,
+                # not convergence.
+                wide_check = True
+                quiet = 1
+                continue
         elif ns == 0 and nc == 0 and not do_swap and not noswap:
             quiet = max(quiet, 1)        # trigger a swap-inclusive cycle
         else:
             quiet = 0
+            wide_check = False
 
     # bad-element optimization: the sizing loop leaves slivers whose edge
     # lengths are all in-range; polish until no sliver op applies

@@ -50,20 +50,11 @@ def _interp_met_mid(met, va, vb):
     return 0.5 * (met[va] + met[vb])
 
 
-def capE_budget(capT: int) -> int:
-    """Per-wave split-winner budget: large enough that a growth wave can
-    still insert capT//8 midpoints, small enough that the apply phase's
-    scatters run at budget width instead of [6*capT] (scatter cost is
-    linear in index count on TPU — scripts/wave_time.py).  Winners past
-    the budget are deferred to the next wave, NOT flagged as overflow.
-    Delegates to the shared wave_budget formula (ops/edges.py)."""
-    from .edges import wave_budget
-    return wave_budget(capT, 8)
-
-
 def split_wave(mesh: Mesh, met: jax.Array, lmax: float = LLONG,
                frozen_vtag: int = MG_REQ | MG_PARBDY,
-               hausd: float | None = None) -> SplitResult:
+               hausd: float | None = None,
+               budget_div: int = 8,
+               fem_only: bool = False) -> SplitResult:
     """One independent-set split wave. Jittable; static shapes throughout.
 
     ``hausd`` enables the PLACEMENT half of surface-approximation
@@ -78,6 +69,19 @@ def split_wave(mesh: Mesh, met: jax.Array, lmax: float = LLONG,
     is (t_a - t_b)/8, exact to O(h^4) on a sphere.  Ridge/corner/required
     endpoints are excluded (their normals are multivalued — the flat
     cube workloads are bit-for-bit unchanged).
+
+    ``fem_only``: instead of long edges, target INTERIOR edges whose two
+    endpoints both lie on the boundary — the FEM-incompatible
+    configuration (an element can end up with all four vertices, or two
+    faces, on the boundary).  Splitting such an edge inserts an interior
+    point, which is exactly Mmg's fem-mode topology fix; the reference
+    forwards ``info.fem`` (default on, API_functions_pmmg.c:413,652) to
+    Mmg per group.
+
+    ``budget_div`` widens/narrows the per-wave winner budget (the shared
+    ops/edges.wave_budget formula; winners past it are deferred to the
+    next wave, NOT flagged as overflow); the convergence-verification
+    wide cycle passes 2.
     """
     capT, capP = mesh.capT, mesh.capP
     et = unique_edges(mesh)
@@ -87,7 +91,13 @@ def split_wave(mesh: Mesh, met: jax.Array, lmax: float = LLONG,
     va = jnp.clip(et.ev[:, 0], 0, capP - 1)
     vb = jnp.clip(et.ev[:, 1], 0, capP - 1)
     frozen_edge = (et.etag & (MG_REQ | MG_PARBDY)) != 0
-    cand = et.emask & (lens > lmax) & ~frozen_edge
+    if fem_only:
+        both_bdy = ((mesh.vtag[va] & MG_BDY) != 0) & \
+            ((mesh.vtag[vb] & MG_BDY) != 0)
+        cand = et.emask & ((et.etag & MG_BDY) == 0) & both_bdy & \
+            ~frozen_edge
+    else:
+        cand = et.emask & (lens > lmax) & ~frozen_edge
     lift_corr = None
     if hausd is not None:
         from .analysis import boundary_vertex_normals
@@ -163,8 +173,9 @@ def split_wave(mesh: Mesh, met: jax.Array, lmax: float = LLONG,
     # (scatter cost is linear in index count — scripts/wave_time.py).
     # The cut is by PRIORITY (longest edges first), not slot order — a
     # slot-order cut would refine the mesh spatially unevenly
-    KW = min(capE_budget(capT), et.ev.shape[0])
-    KH = min(2 * capE_budget(capT), capT)
+    from .edges import wave_budget
+    KW = min(wave_budget(capT, budget_div), et.ev.shape[0])
+    KH = min(2 * wave_budget(capT, budget_div), capT)
     bord = jnp.argsort(jnp.where(win_cap, -lens, jnp.inf))
     win_srt = win_cap[bord]
     off_srt = jnp.cumsum(win_srt.astype(jnp.int32)) - win_srt

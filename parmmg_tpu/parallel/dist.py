@@ -528,11 +528,13 @@ def distributed_adapt_multi(mesh: Mesh, met, n_shards: int,
                                          glo=glo, views=views)
         if it + 1 < max(1, niter) and not nobalancing:
             sizes = jnp.asarray(views.tmask.sum(axis=1).astype(np.int32))
-            labels = np.asarray(flood_labels(
+            labels_d, depth_d = flood_labels(
                 stacked, jnp.asarray(comms.node_idx),
                 jnp.asarray(comms.nbr), sizes, n_shards,
-                nlayers=ifc_layers))
-            labels = enforce_ne_min(labels, views.tmask, n_shards)
+                nlayers=ifc_layers)
+            labels = np.asarray(labels_d)
+            labels = enforce_ne_min(labels, views.tmask, n_shards,
+                                    depth=np.asarray(depth_d))
             # destination shards (band recipients) — computed BEFORE the
             # migration mutates the views/labels shapes
             touched = sorted({int(r) for s_ in range(n_shards)

@@ -91,27 +91,37 @@ def _flood_one(tet, tmask, vmask, node_idx, nbr, sizes, me, n_shards: int,
     vpri = vpri.at[safe].max(nb_pri, mode="drop")
 
     label = jnp.full(tet.shape[0], me, jnp.int32)
+    # front depth: wave index (1-based) at which each tet flipped away
+    # from its home shard; 0 = never flipped.  Consumed by
+    # enforce_ne_min so the donor floor reverts the DEEPEST layer first
+    # and the retained moves stay a connected front
+    # (moveinterfaces_pmmg.c:1343 keeps front order the same way).
+    depth = jnp.zeros(tet.shape[0], jnp.int32)
 
-    def wave(_, carry):
-        vpri, label = carry
+    def wave(w, carry):
+        vpri, label, depth = carry
         corner = vpri[jnp.clip(tet, 0, capP - 1)]            # [T,4]
         tp = jnp.max(corner, axis=1)
         better = tmask & (tp > pri_of(label))
         label = jnp.where(better, (tp % S).astype(jnp.int32), label)
+        depth = jnp.where(better, w + 1, depth)
         # propagate the flipped color to the tet's corners
         lp = jnp.where(tmask, pri_of(label), -1)
         tgt = jnp.where(tmask[:, None], tet, capP).reshape(-1)
         vpri = vpri.at[tgt].max(jnp.repeat(lp, 4), mode="drop")
-        return vpri, label
+        return vpri, label, depth
 
-    _, label = jax.lax.fori_loop(0, nlayers, wave, (vpri, label))
-    return label
+    _, label, depth = jax.lax.fori_loop(0, nlayers, wave,
+                                        (vpri, label, depth))
+    return label, depth
 
 
 @partial(jax.jit, static_argnames=("n_shards", "nlayers"))
 def flood_labels(stacked: Mesh, node_idx, nbr, sizes, n_shards: int,
                  nlayers: int = 2):
-    """[S, capT] int32 target-shard label per tet (garbage on dead slots)."""
+    """([S, capT] int32 target-shard label per tet, [S, capT] int32 flood
+    depth — wave at which the tet flipped, 0 = kept).  Garbage on dead
+    slots."""
     me = jnp.arange(n_shards, dtype=jnp.int32)
     return jax.vmap(
         lambda t, tm, vm, ni, nb, m: _flood_one(
@@ -318,9 +328,15 @@ def comms_from_lists(face_lists, node_lists, owner,
 # the migration step
 # ---------------------------------------------------------------------------
 def enforce_ne_min(labels: np.ndarray, tmask: np.ndarray, n_shards: int,
-                   ne_min: int | None = None) -> np.ndarray:
+                   ne_min: int | None = None,
+                   depth: np.ndarray | None = None) -> np.ndarray:
     """Donor floor: a shard keeps at least ne_min tets
-    (moveinterfaces_pmmg.c:1343 semantics, min(6, ne/2+1) scaled)."""
+    (moveinterfaces_pmmg.c:1343 semantics, min(6, ne/2+1) scaled).
+
+    Excess moves are reverted DEEPEST flood layer first (``depth`` from
+    flood_labels) so the retained prefix stays a connected advancing
+    front — a slot-ordered cut could keep band tets disconnected from
+    the recipient.  Without ``depth`` falls back to slot order."""
     S = n_shards
     lab = labels.copy()
     for s in range(S):
@@ -330,6 +346,10 @@ def enforce_ne_min(labels: np.ndarray, tmask: np.ndarray, n_shards: int,
         moved = np.where(live & (lab[s] != s))[0]
         excess = len(moved) - (n - floor)
         if excess > 0:
+            if depth is not None:
+                # stable sort by flood depth: deepest (latest-flipped)
+                # layers revert first, ties keep slot order
+                moved = moved[np.argsort(depth[s][moved], kind="stable")]
             lab[s][moved[len(moved) - excess:]] = s
     return lab
 
@@ -573,18 +593,24 @@ def _retag_interfaces(views: ShardViews, glo, ifc_face_slots,
         # global interface edge keys = edges of the new interface faces
         et = views.etag[s]
         g = glo[s]
+        # pack (gid_a, gid_b) with the CURRENT id bound, not a fixed
+        # 1<<31: session global ids grow monotonically (extend_global_ids
+        # never reuses freed ids), so a fixed base would silently alias
+        # distinct edges once any id crosses it.  int64 keys stay exact
+        # up to base ~ 3e9.
+        base = np.int64(max(int(g.max()) + 1, 1))
         if ifc_face_slots[s]:
             sl = np.asarray(ifc_face_slots[s], np.int64)
             tri = np.sort(g[views.tet[s][sl // 4]][
                 np.arange(len(sl))[:, None], IDIR[sl % 4]], axis=1)
             ek = np.concatenate([
                 tri[:, [0, 1]], tri[:, [0, 2]], tri[:, [1, 2]]])
-            ekey = np.unique(ek[:, 0] * (1 << 31) + ek[:, 1])
+            ekey = np.unique(ek[:, 0] * base + ek[:, 1])
         else:
             ekey = np.zeros(0, np.int64)
         gtet = g[views.tet[s]]
         ev = np.sort(gtet[:, IARE], axis=2)             # [T,6,2]
-        slot_key = ev[..., 0] * (1 << 31) + ev[..., 1]
+        slot_key = ev[..., 0] * base + ev[..., 1]
         in_new = np.zeros(slot_key.shape, bool)
         if len(ekey):
             p = np.searchsorted(ekey, slot_key)

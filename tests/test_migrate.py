@@ -54,10 +54,16 @@ def test_flood_labels_advance_into_smaller():
         g2l.append(mm)
     comms = build_interface_comms(tet_h, part, 2, l2g, g2l)
     sizes = jnp.asarray(np.asarray(s.tmask).sum(axis=1).astype(np.int32))
-    labels = np.asarray(flood_labels(
+    labels, depth = flood_labels(
         s, jnp.asarray(comms.node_idx), jnp.asarray(comms.nbr),
-        sizes, 2, nlayers=2))
+        sizes, 2, nlayers=2)
+    labels, depth = np.asarray(labels), np.asarray(depth)
     tm = np.asarray(s.tmask)
+    # flood depth: every flipped tet records its wave (1 or 2); kept
+    # tets record 0 (consumed by enforce_ne_min's front-ordered revert)
+    flipped = tm[0] & (labels[0] != 0)
+    assert set(np.unique(depth[0][flipped])) <= {1, 2}
+    assert (depth[0][tm[0] & ~flipped] == 0).all()
     # the big shard (1) keeps everything; the small shard (0) donates a
     # band to shard 1
     assert (labels[1][tm[1]] == 1).all()

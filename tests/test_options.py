@@ -118,3 +118,50 @@ def test_local_parameters_clamp_sizes():
     assert met[near].max() <= 0.15 + 1e-5
     far = out_v[:, 2] > 0.7
     assert met[far].min() > 0.15
+
+
+def _fem_bad_edges(mesh):
+    """Interior edges whose two endpoints both lie on the boundary (the
+    FEM-incompatible configuration)."""
+    from parmmg_tpu.core.constants import IARE, MG_BDY
+    tet = np.asarray(mesh.tet)
+    tm = np.asarray(mesh.tmask)
+    etag = np.asarray(mesh.etag)
+    vtag = np.asarray(mesh.vtag)
+    ev = np.sort(tet[:, IARE], axis=2)[tm]               # [nt,6,2]
+    interior = (etag[tm] & MG_BDY) == 0
+    both_bdy = ((vtag[ev[..., 0]] & MG_BDY) != 0) & \
+        ((vtag[ev[..., 1]] & MG_BDY) != 0)
+    bad = ev[interior & both_bdy]
+    return {tuple(e) for e in bad.reshape(-1, 2)}
+
+
+def test_fem_mode_removes_interior_bdy_bdy_edges():
+    """Default fem mode (reference default MMG5_FEM,
+    API_functions_pmmg.c:413): after the run, no interior edge connects
+    two boundary points — so no element has two boundary faces or all
+    four vertices on the boundary."""
+    pm = _run_ok(_staged(hsiz=0.4))
+    assert pm.info.fem
+    assert not _fem_bad_edges(pm._out)
+
+
+def test_nofem_skips_fem_splits(monkeypatch):
+    """-nofem: the fem conformity pass is skipped (flag must act, not
+    decorate) — counted via the fem_pass entry point."""
+    import parmmg_tpu.ops.adapt as adapt_mod
+    calls = {"n": 0}
+    orig = adapt_mod.fem_pass
+
+    def counting(*a, **k):
+        calls["n"] += 1
+        return orig(*a, **k)
+
+    monkeypatch.setattr(adapt_mod, "fem_pass", counting)
+    pm = _staged(hsiz=0.4)
+    pm.info.fem = False
+    _run_ok(pm)
+    assert calls["n"] == 0
+    pm2 = _staged(hsiz=0.4)
+    _run_ok(pm2)
+    assert calls["n"] > 0
