@@ -91,6 +91,7 @@ def main() -> None:
     n = int(os.environ.get("BENCH_N", "16"))          # 6*n^3 tets
     cycles = int(os.environ.get("BENCH_CYCLES", "9"))
     block = int(os.environ.get("BENCH_BLOCK", "3"))   # fused cycles/dispatch
+    bdiv = int(os.environ.get("BENCH_BUDGET_DIV", "8"))  # wave top-K div
 
     vert, tet = cube_mesh(n)
     mesh = make_mesh(vert, tet, capP=3 * len(vert), capT=3 * len(tet))
@@ -117,11 +118,13 @@ def main() -> None:
     # a copy of the state (AOT .lower().compile() would not populate the
     # jit dispatch cache).
     m1, k1, wcnt = adapt_cycles_fused(mesh, met, jnp.asarray(0, jnp.int32),
-                                      n_cycles=block, swap_every=3)
+                                      n_cycles=block, swap_every=3,
+                                      budget_div=bdiv)
     jax.block_until_ready(wcnt)
     m1, k1, wcnt = adapt_cycles_fused(m1, k1, jnp.asarray(block, jnp.int32),
                                       n_cycles=block, swap_every=3,
-                                      swap_offset=block % 3)
+                                      swap_offset=block % 3,
+                                      budget_div=bdiv)
     jax.block_until_ready(wcnt)
     for nc, off in sorted({(nc, off) for _, nc, off in sched}
                           - {(block, 0)}):
@@ -129,7 +132,7 @@ def main() -> None:
         kc = jnp.copy(k1)
         _, _, c = adapt_cycles_fused(mc, kc, jnp.asarray(0, jnp.int32),
                                      n_cycles=nc, swap_every=3,
-                                     swap_offset=off)
+                                     swap_offset=off, budget_div=bdiv)
         jax.block_until_ready(c)
 
     # timed loop: cycles run in fused blocks of `block` (one dispatch +
@@ -144,7 +147,7 @@ def main() -> None:
         t0 = time.perf_counter()
         m, k, counts = adapt_cycles_fused(
             m, k, jnp.asarray(warm_cycles + b, jnp.int32), n_cycles=nc,
-            swap_every=3, swap_offset=off)
+            swap_every=3, swap_offset=off, budget_div=bdiv)
         cs = np.asarray(counts)                   # blocks on this block
         times.append(time.perf_counter() - t0)
         # tets examined this block = sum over cycles of live-at-entry

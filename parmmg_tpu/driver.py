@@ -454,7 +454,11 @@ def interpolate_fields(bg: Mesh, fields: list[np.ndarray], new: Mesh)\
     on_bdy = (np.asarray(new.vtag)[vm] & MG_BDY) != 0
     loc = locate_points(bg, jnp.asarray(pts, new.vert.dtype),
                         jnp.zeros(len(pts), jnp.int32))
-    sloc = locate_points_bdy(bg, jnp.asarray(pts, new.vert.dtype)) \
+    # the surface walk runs on the boundary SUBSET only (the volume pass
+    # would feed interior points through the closest-triangle machinery
+    # for nothing — and its intermediates scale with the query count)
+    sloc = locate_points_bdy(
+        bg, jnp.asarray(pts[on_bdy], new.vert.dtype)) \
         if on_bdy.any() else None
     out = []
     for f in fields:
@@ -462,9 +466,8 @@ def interpolate_fields(bg: Mesh, fields: list[np.ndarray], new: Mesh)\
         full[: len(f)] = f
         vals = np.asarray(interp_p1(jnp.asarray(full), bg.tet, loc))
         if sloc is not None:
-            vals_b = np.asarray(interp_p1_tri(jnp.asarray(full), bg,
-                                              sloc))
-            sel = on_bdy.reshape(on_bdy.shape + (1,) * (vals.ndim - 1))
-            vals = np.where(sel, vals_b, vals)
+            vals = np.array(vals, copy=True)
+            vals[on_bdy] = np.asarray(
+                interp_p1_tri(jnp.asarray(full), bg, sloc))
         out.append(vals)
     return out

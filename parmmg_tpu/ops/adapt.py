@@ -85,12 +85,21 @@ def adapt_cycle_impl(mesh: Mesh, met: jax.Array, wave: jax.Array,
     """
     from .adjacency import boundary_edge_tags
     if do_insert:
-        res = split_wave(mesh, met, hausd=hausd, budget_div=budget_div)
+        # ONE edge table + metric lengths serve both split and collapse
+        # (the tables are a measured wave hot spot); the collapse defers
+        # candidates whose table rows the split made stale
+        from .edges import unique_edges, edge_lengths
+        et0 = unique_edges(mesh)
+        lens0 = edge_lengths(mesh, et0, met)
+        res = split_wave(mesh, met, hausd=hausd, budget_div=budget_div,
+                         et=et0, lens=lens0)
         mesh, met = res.mesh, res.met
         nsplit, overflow = res.nsplit, res.overflow
 
         col = collapse_wave(mesh, met, hausd=hausd,
-                            budget_div=budget_div)
+                            budget_div=budget_div,
+                            et=et0, lens=lens0,
+                            stale_tets=res.modified)
         # collapse rewires the surface (dying tets' face tags transfer to
         # the surviving neighbors); re-propagate MG_BDY from faces to
         # their edges and vertices so later splits/smooth treat the new
@@ -158,37 +167,67 @@ fem_pass = partial(jax.jit, donate_argnums=(0, 1))(fem_pass_impl)
 def adapt_cycles_fused_impl(mesh: Mesh, met: jax.Array, wave0: jax.Array,
                             n_cycles: int = 3, swap_every: int = 3,
                             swap_offset: int = 0,
-                            hausd: float | None = None):
+                            hausd: float | None = None,
+                            swap_flags: tuple | None = None,
+                            do_smooth: bool = True,
+                            do_insert: bool = True,
+                            budget_div: int = 8):
     """``n_cycles`` adaptation cycles in ONE jitted program.
 
     On a remote-attached TPU every dispatch pays a transport round trip
     (and the per-cycle counter pull is a host sync); fusing a block of
     cycles amortizes both and gives XLA one big program to schedule.  The
     swap cadence is compiled in (cycle c swaps iff c % swap_every ==
-    swap_every-1, matching the host driver); counters come back stacked
-    [n_cycles, 6] and are read with a single transfer.
+    swap_every-1, matching the host driver — or pass ``swap_flags``, an
+    explicit per-cycle tuple overriding the cadence, which also sets
+    n_cycles); counters come back stacked [n_cycles, 6] and are read
+    with a single transfer.
 
     Overflow safety: a capacity overflow inside the block only truncates
     that cycle's winner set (split_wave drops the lowest-priority winners
     that don't fit); the flag is reported per cycle so the host can regrow
     and rerun as usual.
     """
+    if swap_flags is None:
+        swap_flags = tuple(
+            (c + swap_offset) % swap_every == swap_every - 1
+            for c in range(n_cycles))
     counts_all = []
-    for c in range(n_cycles):
-        # cadence over the GLOBAL cycle index: callers running blocks of
-        # arbitrary size pass swap_offset = global_cycle0 % swap_every so
-        # the swap rhythm matches the unfused host driver exactly
-        do_swap = ((c + swap_offset) % swap_every == swap_every - 1)
+    for c, dosw in enumerate(swap_flags):
         mesh, met, counts = adapt_cycle_impl(
-            mesh, met, wave0 + c, do_swap=do_swap,
-            final_rebuild=(c == n_cycles - 1), hausd=hausd)
+            mesh, met, wave0 + c, do_swap=dosw,
+            do_smooth=do_smooth, do_insert=do_insert,
+            final_rebuild=(c == len(swap_flags) - 1), hausd=hausd,
+            budget_div=budget_div)
         counts_all.append(counts)
     return mesh, met, jnp.stack(counts_all)
 
 
 adapt_cycles_fused = partial(jax.jit, static_argnames=(
-    "n_cycles", "swap_every", "swap_offset", "hausd"),
+    "n_cycles", "swap_every", "swap_offset", "hausd", "swap_flags",
+    "do_smooth", "do_insert", "budget_div"),
     donate_argnums=(0, 1))(adapt_cycles_fused_impl)
+
+
+def default_cycle_block(x=None) -> int:
+    """Fused cycles per dispatch for the production drivers: 3 on TPU
+    (each dispatch pays a ~70-110 ms tunnel round trip — the bench's
+    measured amortization), 1 elsewhere (a local backend gains nothing
+    and the CPU test matrix would pay 3x the compile time).  Override
+    with PARMMG_CYCLE_BLOCK."""
+    import os
+    v = os.environ.get("PARMMG_CYCLE_BLOCK", "")
+    if v:
+        return max(1, int(v))
+    plat = None
+    try:
+        if x is not None and hasattr(x, "devices"):
+            plat = next(iter(x.devices())).platform
+    except Exception:
+        plat = None
+    if plat is None:
+        plat = jax.default_backend()
+    return 3 if plat == "tpu" else 1
 
 
 def sliver_polish_impl(mesh: Mesh, met: jax.Array, wave: jax.Array,
@@ -216,14 +255,21 @@ def sliver_polish_impl(mesh: Mesh, met: jax.Array, wave: jax.Array,
         mesh = boundary_edge_tags(col.mesh)
         ncol = col.ncollapse
     if do_swap:
+        from .swapgen import swapgen_wave
         sew = swap_edges_wave(mesh, met, hausd=hausd,
                               budget_div=2)  # 3-2 + 2-2
-        mesh = build_adjacency(sew.mesh)        # consumed by swap23
+        # generalized degree 4-6 ring swaps: the worst surviving tets
+        # are typically gate-limited for every lower-degree op — this
+        # is the class that lifts the min past the 3-2/2-3 plateau
+        sgn = swapgen_wave(sew.mesh, met, budget_div=2)
+        mesh = build_adjacency(sgn.mesh)        # consumed by swap23
         s23 = swap23_wave(mesh, met, budget_div=2)
         mesh = s23.mesh
-        nswap = sew.nswap + s23.nswap
+        nswap = sew.nswap + sgn.nswap + s23.nswap
     if do_smooth:
-        sm = smooth_wave(mesh, met, wave=wave)
+        # optimal-position mode: sliver-ball vertices ascend the height
+        # of their worst incident tet instead of chasing the centroid
+        sm = smooth_wave(mesh, met, wave=wave, opt_q=sliver_q)
         mesh = sm.mesh
         nmoved = sm.nmoved
     mesh = build_adjacency(mesh)                # exit contract
@@ -251,7 +297,8 @@ def adapt_mesh(mesh: Mesh, met: jax.Array, max_cycles: int = 50,
                swap_every: int = 3, noinsert: bool = False,
                noswap: bool = False, nomove: bool = False,
                angedg: float | None = None,
-               hausd: float | None = None) -> tuple:
+               hausd: float | None = None,
+               cycle_block: int | None = None) -> tuple:
     """Host driver: run cycles until no topological change, manage capacity.
 
     Swap waves cost about as much as split+collapse+smooth combined (they
@@ -259,6 +306,11 @@ def adapt_mesh(mesh: Mesh, met: jax.Array, max_cycles: int = 50,
     ``swap_every``-th cycle — like Mmg, which interleaves swap/move passes
     between sizing passes rather than swapping continuously — and always
     once the mesh is near convergence.
+
+    Cycles are dispatched in fused blocks of ``cycle_block`` (default:
+    3 on TPU, 1 elsewhere — see default_cycle_block): on the tunneled
+    chip every dispatch pays a transport round trip and a counter pull,
+    so the production driver pays one per BLOCK, exactly like bench.py.
 
     Returns (mesh, met, AdaptStats).
     """
@@ -268,10 +320,16 @@ def adapt_mesh(mesh: Mesh, met: jax.Array, max_cycles: int = 50,
     # honor the caller's ridge-detection threshold (-ar / -nr): a default
     # re-analysis here would re-introduce MG_GEO tags the user disabled
     mesh = analyze_mesh(mesh, ANGEDG if angedg is None else angedg).mesh
+    if cycle_block is None:
+        cycle_block = default_cycle_block(mesh.vert)
     quiet = 0
     wide_check = False
-    for cycle in range(max_cycles):
-        # capacity management before the wave
+    converged = False
+    cycle = 0
+    while cycle < max_cycles and not converged:
+        # capacity management before the wave block (each block can add
+        # up to block * 2*capT/8 tets; the overflow flag + regrow below
+        # catches a mid-block shortfall, winners are only deferred)
         n_p, n_t = mesh.np_counts()
         if n_p > headroom * mesh.capP or n_t > headroom * mesh.capT:
             mesh, met = grow_mesh_met(mesh, met,
@@ -279,47 +337,80 @@ def adapt_mesh(mesh: Mesh, met: jax.Array, max_cycles: int = 50,
                                       max(mesh.capT, int(2 * n_t)))
             stats.regrows += 1
 
-        do_swap = ((cycle % swap_every == swap_every - 1) or quiet > 0) \
-            and not noswap
-        mesh, met, counts = adapt_cycle(
-            mesh, met, jnp.asarray(cycle, jnp.int32), do_swap=do_swap,
-            do_smooth=not nomove, do_insert=not noinsert, hausd=hausd,
-            budget_div=2 if wide_check else 8)
-        ns, nc, nw, nm, ovf, _ = (int(v) for v in np.asarray(counts))
-        stats.nsplit += ns
-        stats.ncollapse += nc
-        stats.nswap += nw
-        stats.nmoved += nm
-        stats.cycles += 1
-        if verbose >= 3:
-            print(f"  cycle {cycle:3d}: split {ns:6d} collapse {nc:6d} "
-                  f"swap {nw:6d} move {nm:6d}")
-        if ovf:
-            mesh, met = grow_mesh_met(mesh, met, 2 * mesh.capP, 2 * mesh.capT)
-            stats.regrows += 1
-            continue
-        if ns == 0 and nc == 0 and (noswap or (nw == 0 and do_swap)):
-            quiet += 1
-            if quiet >= 2 or nm == 0 or nomove:
-                if wide_check or (noinsert and noswap):
-                    # (with insertions AND swaps disabled no budget-
-                    # governed op runs — a wide cycle cannot differ)
-                    break
-                # Verify convergence at a wider candidate budget before
-                # accepting it: with top-K compaction, candidates that
-                # permanently fail the post-compaction geometric gates
-                # (worst shell quality = always selected) can pin every
-                # budget slot while viable candidates ranked past K are
-                # never attempted — counts==0 would then be starvation,
-                # not convergence.
-                wide_check = True
-                quiet = 1
-                continue
-        elif ns == 0 and nc == 0 and not do_swap and not noswap:
-            quiet = max(quiet, 1)        # trigger a swap-inclusive cycle
+        was_wide = wide_check
+        # single-cycle dispatch when quiet: the quiet>0-forces-swap rule
+        # (convergence confirmation) is per-cycle state the compiled
+        # block cadence cannot see
+        if wide_check or cycle_block == 1 or quiet > 0:
+            do_swap = ((cycle % swap_every == swap_every - 1)
+                       or quiet > 0) and not noswap
+            mesh, met, counts = adapt_cycle(
+                mesh, met, jnp.asarray(cycle, jnp.int32), do_swap=do_swap,
+                do_smooth=not nomove, do_insert=not noinsert, hausd=hausd,
+                budget_div=2 if wide_check else 8)
+            rows = [(do_swap, np.asarray(counts))]
         else:
-            quiet = 0
-            wide_check = False
+            nblk = min(cycle_block, max_cycles - cycle)
+            flags = tuple(
+                (((cycle + c) % swap_every == swap_every - 1)
+                 and not noswap) for c in range(nblk))
+            mesh, met, counts_all = adapt_cycles_fused(
+                mesh, met, jnp.asarray(cycle, jnp.int32),
+                swap_flags=flags, hausd=hausd,
+                do_smooth=not nomove, do_insert=not noinsert)
+            ca = np.asarray(counts_all)
+            rows = [(flags[c], ca[c]) for c in range(nblk)]
+
+        ovf_any = False
+        for do_swap, cnt in rows:
+            ns, nc, nw, nm, ovf, _ = (int(v) for v in cnt)
+            stats.nsplit += ns
+            stats.ncollapse += nc
+            stats.nswap += nw
+            stats.nmoved += nm
+            stats.cycles += 1
+            if verbose >= 3:
+                print(f"  cycle {cycle:3d}: split {ns:6d} "
+                      f"collapse {nc:6d} swap {nw:6d} move {nm:6d}")
+            cycle += 1
+            if ovf:
+                # a capacity-truncated cycle cannot witness convergence
+                # (its winner set was cut, not exhausted) — reset the
+                # quiet state and force the regrow below
+                ovf_any = True
+                quiet = 0
+                wide_check = False
+                converged = False
+                continue
+            if converged:
+                continue        # later block rows: stats only
+            if ns == 0 and nc == 0 and (noswap or (nw == 0 and do_swap)):
+                quiet += 1
+                if quiet >= 2 or nm == 0 or nomove:
+                    if was_wide or (noinsert and noswap):
+                        # (with insertions AND swaps disabled no budget-
+                        # governed op runs — a wide cycle cannot differ)
+                        converged = True
+                        continue
+                    # Verify convergence at a wider candidate budget
+                    # before accepting it: with top-K compaction,
+                    # candidates that permanently fail the
+                    # post-compaction geometric gates (worst shell
+                    # quality = always selected) can pin every budget
+                    # slot while viable candidates ranked past K are
+                    # never attempted — counts==0 would then be
+                    # starvation, not convergence.
+                    wide_check = True
+                    quiet = 1
+            elif ns == 0 and nc == 0 and not do_swap and not noswap:
+                quiet = max(quiet, 1)    # trigger a swap-inclusive cycle
+            else:
+                quiet = 0
+                wide_check = False
+        if ovf_any:
+            mesh, met = grow_mesh_met(mesh, met, 2 * mesh.capP,
+                                      2 * mesh.capT)
+            stats.regrows += 1
 
     # bad-element optimization: the sizing loop leaves slivers whose edge
     # lengths are all in-range; polish until no sliver op applies

@@ -65,7 +65,9 @@ def collapse_wave(mesh: Mesh, met: jax.Array, lmin: float = LSHRT,
                   lmax: float = LLONG,
                   sliver_q: float | None = None,
                   hausd: float | None = None,
-                  budget_div: int = 8) -> CollapseResult:
+                  budget_div: int = 8,
+                  et=None, lens=None,
+                  stale_tets: jax.Array | None = None) -> CollapseResult:
     """One independent-set collapse wave.
 
     Normal mode: contract edges shorter than ``lmin`` (Mmg's colver over
@@ -75,10 +77,20 @@ def collapse_wave(mesh: Mesh, met: jax.Array, lmin: float = LSHRT,
     improves the min quality over the removed vertex's ball — the batched
     analogue of Mmg's bad-element optimization pass (``MMG3D_opttyp``
     collapses on ``MMG3D_BADKAL`` elements).
+
+    ``et``/``lens``/``stale_tets``: shared-table mode.  adapt_cycle_impl
+    builds ONE edge table + lengths before the split wave and passes
+    them to both ops; ``stale_tets`` is the split's modification
+    footprint, and any candidate edge touching a vertex of a modified
+    tet is deferred to the next wave (its table row describes pre-split
+    geometry).  Validity/quality below run against the CURRENT (post-
+    split) mesh arrays, which are identical on every unmodified slot.
     """
     capT, capP = mesh.capT, mesh.capP
-    et = unique_edges(mesh)
-    lens = edge_lengths(mesh, et, met)
+    if et is None:
+        et = unique_edges(mesh)
+    if lens is None:
+        lens = edge_lengths(mesh, et, met)
     Efull = et.ev.shape[0]
     va_f = jnp.clip(et.ev[:, 0], 0, capP - 1)
     vb_f = jnp.clip(et.ev[:, 1], 0, capP - 1)
@@ -86,6 +98,13 @@ def collapse_wave(mesh: Mesh, met: jax.Array, lmin: float = LSHRT,
     frozen_edge = (et.etag & (MG_REQ | MG_PARBDY)) != 0
     if sliver_q is None:
         short = et.emask & (lens < lmin) & ~frozen_edge
+        if stale_tets is not None:
+            # staleness veto: vertices of any tet the split modified
+            stale_v = jnp.zeros(capP + 1, bool).at[
+                jnp.where(stale_tets[:, None], mesh.tet, capP)
+                .reshape(-1)].max(
+                jnp.repeat(stale_tets, 4), mode="drop")[:capP]
+            short = short & ~stale_v[va_f] & ~stale_v[vb_f]
     else:
         from .quality import quality_from_points
         q_tet = quality_from_points(
@@ -118,166 +137,202 @@ def collapse_wave(mesh: Mesh, met: jax.Array, lmin: float = LSHRT,
         dev = jnp.linalg.norm(0.125 * (t_a - t_b), axis=-1)
         pre = pre & ~(on_bdy_f & (dev > hausd))
 
-    # top-K compaction (scripts/wave_time.py cost lever): the K highest-
-    # priority candidates go through the heavy machinery; claims stay
-    # exact (they resolve against global vertex/tet pools) and deferred
-    # candidates are picked up by the next wave.  Priority: shortest
-    # edges in sizing mode; WORST incident tet in sliver mode (the pass
-    # exists to raise the min — edge length would misrank the targets)
-    from .edges import wave_budget
-    K = min(Efull, wave_budget(capT, budget_div))
-    if sliver_q is None:
-        prio = lens
-    else:
-        eq_min = jnp.full(Efull, jnp.inf).at[
-            et.edge_id.reshape(-1)].min(
-            jnp.repeat(jnp.where(bad_tet, q_tet, jnp.inf), 6),
-            mode="drop")
-        prio = eq_min
-    sel = jnp.argsort(jnp.where(pre, prio, jnp.inf))[:K]
-    lens_c = lens[sel]
-    va = va_f[sel]
-    vb = vb_f[sel]
-    cand = pre[sel]
-    del_b = rem_b_f[sel]
-    rm = jnp.where(del_b, vb, va)
-    kp = jnp.where(del_b, va, vb)
+    # Everything below (top-K sort, role derivation, tet-centric
+    # validity, claims, apply) is lax.cond-skipped when NO candidate
+    # exists — at convergence the wave then costs only the table +
+    # candidacy masks.
+    def _idle(_):
+        return CollapseResult(mesh, jnp.zeros((), jnp.int32))
 
-    # sort-free claim priority: (s, t) = (-length, unique hash); shorter
-    # edge = higher score, ties broken without spatial bias
-    s, t = claim_channels(-lens_c, cand)
-    # per-vertex top remover and its kept endpoint; v_s/v_t are the
-    # per-vertex channel maxima (the sortless 'rmpri')
-    is_top, v_s, v_t = scatter_argmax2(rm, s, t, cand, capP)
-    kept_of = jnp.zeros(capP, jnp.int32).at[
-        jnp.where(is_top, rm, capP)].set(kp, mode="drop",
-                                         unique_indices=True)
+    def _act(_):
+        # top-K compaction (scripts/wave_time.py cost lever): the K highest-
+        # priority candidates go through the heavy machinery; claims stay
+        # exact (they resolve against global vertex/tet pools) and deferred
+        # candidates are picked up by the next wave.  Priority: shortest
+        # edges in sizing mode; WORST incident tet in sliver mode (the pass
+        # exists to raise the min — edge length would misrank the targets)
+        from .edges import wave_budget
+        K = min(Efull, wave_budget(capT, budget_div))
+        if sliver_q is None:
+            prio = lens
+        else:
+            eq_min = jnp.full(Efull, jnp.inf).at[
+                et.edge_id.reshape(-1)].min(
+                jnp.repeat(jnp.where(bad_tet, q_tet, jnp.inf), 6),
+                mode="drop")
+            prio = eq_min
+        sel = jnp.argsort(jnp.where(pre, prio, jnp.inf))[:K]
+        lens_c = lens[sel]
+        va = va_f[sel]
+        vb = vb_f[sel]
+        cand = pre[sel]
+        del_b = rem_b_f[sel]
+        rm = jnp.where(del_b, vb, va)
+        kp = jnp.where(del_b, va, vb)
 
-    # --- geometric validity of top removers, tet-centric -----------------
-    # for each (tet, corner k): v = tet[k]; if v is a top-removal target,
-    # simulate v -> kept_of[v] and test volumes / fold-over / new lengths.
-    tv = mesh.tet                                          # [T,4]
-    vpos = mesh.vert[tv]                                   # [T,4,3]
-    vs_c = v_s[tv]                                         # [T,4] score max
-    vt_c = v_t[tv]                                         # [T,4] tie max
-    has_c = jnp.isfinite(vs_c)        # corner is a top-removal target
-    kept = kept_of[tv]                                     # [T,4]
-    kept_pos = mesh.vert[kept]                             # [T,4,3]
-    # does this tet also contain the kept vertex? then it dies, skip checks
-    contains_kept = jnp.zeros((capT, 4), bool)
-    for k in range(4):
-        hit = jnp.zeros((capT,), bool)
-        for j in range(4):
-            hit = hit | ((tv[:, j] == kept[:, k]) & (j != k))
-        contains_kept = contains_kept.at[:, k].set(hit)
+        # sort-free claim priority: (s, t) = (-length, unique hash); shorter
+        # edge = higher score, ties broken without spatial bias
+        s, t = claim_channels(-lens_c, cand)
+        # per-vertex top remover and its kept endpoint; v_s/v_t are the
+        # per-vertex channel maxima (the sortless 'rmpri')
+        is_top, v_s, v_t = scatter_argmax2(rm, s, t, cand, capP)
+        kept_of = jnp.zeros(capP, jnp.int32).at[
+            jnp.where(is_top, rm, capP)].set(kp, mode="drop",
+                                             unique_indices=True)
 
-    # elementwise validity math stays per-corner (XLA fuses it); only the
-    # SCATTERS are concatenated into one long op — per-op overhead
-    # dominates scatter cost on this device (scripts/tpu_microbench.py)
-    idx_act = []
-    bad_all = []
-    act_all = []
-    for k in range(4):
-        active = has_c[:, k] & mesh.tmask & ~contains_kept[:, k]
-        p = vpos.at[:, k].set(kept_pos[:, k])              # moved corner
-        d1 = p[:, 1] - p[:, 0]
-        d2 = p[:, 2] - p[:, 0]
-        d3 = p[:, 3] - p[:, 0]
-        vol = jnp.einsum("ti,ti->t", d1, jnp.cross(d2, d3)) / 6.0
-        bad = vol <= EPSD
-        # fold-over: boundary faces containing corner k keep orientation
-        for f in range(4):
-            if k == f:
-                continue  # face opposite k does not contain k
-            idx = IDIR[f]
-            n_old = jnp.cross(vpos[:, idx[1]] - vpos[:, idx[0]],
-                              vpos[:, idx[2]] - vpos[:, idx[0]])
-            n_new = jnp.cross(p[:, idx[1]] - p[:, idx[0]],
-                              p[:, idx[2]] - p[:, idx[0]])
-            isb = (mesh.ftag[:, f] & MG_BDY) != 0
-            flip = jnp.sum(n_old * n_new, -1) <= 0
-            bad = bad | (isb & flip)
-        # overlong new edges from the kept vertex to the other corners
-        if met.ndim == 1:
-            from .quality import edge_length_iso
+        # --- geometric validity of top removers, tet-centric -----------------
+        # for each (tet, corner k): v = tet[k]; if v is a top-removal target,
+        # simulate v -> kept_of[v] and test volumes / fold-over / new lengths.
+        tv = mesh.tet                                          # [T,4]
+        vpos = mesh.vert[tv]                                   # [T,4,3]
+        vs_c = v_s[tv]                                         # [T,4] score max
+        vt_c = v_t[tv]                                         # [T,4] tie max
+        has_c = jnp.isfinite(vs_c)        # corner is a top-removal target
+        kept = kept_of[tv]                                     # [T,4]
+        kept_pos = mesh.vert[kept]                             # [T,4,3]
+        # does this tet also contain the kept vertex? then it dies, skip checks
+        contains_kept = jnp.zeros((capT, 4), bool)
+        for k in range(4):
+            hit = jnp.zeros((capT,), bool)
             for j in range(4):
-                if j == k:
-                    continue
-                lnew = edge_length_iso(
-                    kept_pos[:, k], p[:, j],
-                    met[kept[:, k]], met[tv[:, j]])
-                bad = bad | (lnew > lmax)
-        idx_act.append(jnp.where(active, tv[:, k], capP))
-        bad_all.append(bad)
-        act_all.append(active)
-    idx_act = jnp.concatenate(idx_act)                     # [4T]
-    geombad = jnp.zeros(capP + 1, bool).at[idx_act].max(
-        jnp.concatenate(bad_all), mode="drop")[:capP]
+                hit = hit | ((tv[:, j] == kept[:, k]) & (j != k))
+            contains_kept = contains_kept.at[:, k].set(hit)
 
-    # --- ball-quality gate ----------------------------------------------
-    # Simulate the surviving ball of each removal target and compare min
-    # qualities (dying tets drop out).  Normal mode: the collapse must not
-    # degrade the ball min quality below 30% of its old value nor below
-    # the degeneracy floor (MMG5_colver's calnew/calold check — without
-    # it, aggressive coarsening flattens boundary regions into
-    # zero-volume slivers that interior-only swaps never repair).  Sliver
-    # mode: STRICT improvement (the pass exists to raise the min).
-    from .quality import quality_from_points
-    mq = None if met.ndim == 1 else met[tv]
-    if sliver_q is None:
-        q_tet = quality_from_points(vpos, mq)
-    idx4c = jnp.concatenate(
-        [jnp.where(mesh.tmask, tv[:, k], capP) for k in range(4)])
-    ballq_old = jnp.full(capP + 1, jnp.inf).at[idx4c].min(
-        jnp.tile(jnp.where(mesh.tmask, q_tet, jnp.inf), 4), mode="drop")
-    # the 4 moved-corner variants as ONE stacked quality call + scatter
-    variants = jnp.concatenate(
-        [vpos.at[:, k].set(kept_pos[:, k]) for k in range(4)])
-    mq4 = None if mq is None else jnp.concatenate(
-        [mq.at[:, k].set(met[kept[:, k]]) for k in range(4)])
-    qv = quality_from_points(variants, mq4)                # [4T]
-    act4 = jnp.concatenate(act_all)
-    ballq_new = jnp.full(capP + 1, jnp.inf).at[idx_act].min(
-        jnp.where(act4, qv, jnp.inf), mode="drop")
-    if sliver_q is None:
-        ok = (ballq_new[:capP] >= 0.3 * ballq_old[:capP]) & \
-             (ballq_new[:capP] > QUAL_FLOOR)
-        geombad = geombad | ~ok
-    else:
-        improves = ballq_new[:capP] > ballq_old[:capP]
-        geombad = geombad | ~improves
+        # elementwise validity math stays per-corner (XLA fuses it); only the
+        # SCATTERS are concatenated into one long op — per-op overhead
+        # dominates scatter cost on this device (scripts/tpu_microbench.py)
+        idx_act = []
+        bad_all = []
+        act_all = []
+        for k in range(4):
+            active = has_c[:, k] & mesh.tmask & ~contains_kept[:, k]
+            p = vpos.at[:, k].set(kept_pos[:, k])              # moved corner
+            d1 = p[:, 1] - p[:, 0]
+            d2 = p[:, 2] - p[:, 0]
+            d3 = p[:, 3] - p[:, 0]
+            vol = jnp.einsum("ti,ti->t", d1, jnp.cross(d2, d3)) / 6.0
+            bad = vol <= EPSD
+            # fold-over: boundary faces containing corner k keep orientation
+            for f in range(4):
+                if k == f:
+                    continue  # face opposite k does not contain k
+                idx = IDIR[f]
+                n_old = jnp.cross(vpos[:, idx[1]] - vpos[:, idx[0]],
+                                  vpos[:, idx[2]] - vpos[:, idx[0]])
+                n_new = jnp.cross(p[:, idx[1]] - p[:, idx[0]],
+                                  p[:, idx[2]] - p[:, idx[0]])
+                isb = (mesh.ftag[:, f] & MG_BDY) != 0
+                flip = jnp.sum(n_old * n_new, -1) <= 0
+                bad = bad | (isb & flip)
+            # overlong new edges from the kept vertex to the other corners
+            if met.ndim == 1:
+                from .quality import edge_length_iso
+                for j in range(4):
+                    if j == k:
+                        continue
+                    lnew = edge_length_iso(
+                        kept_pos[:, k], p[:, j],
+                        met[kept[:, k]], met[tv[:, j]])
+                    bad = bad | (lnew > lmax)
+            idx_act.append(jnp.where(active, tv[:, k], capP))
+            bad_all.append(bad)
+            act_all.append(active)
+        idx_act = jnp.concatenate(idx_act)                     # [4T]
+        geombad = jnp.zeros(capP + 1, bool).at[idx_act].max(
+            jnp.concatenate(bad_all), mode="drop")[:capP]
 
-    # --- claims (two-channel, sort-free) ---------------------------------
-    # tet claim = (s,t)-max removal target over the 4 corners; a corner
-    # with a target loses its tets if it is not the tet's max holder
-    tmax_s = jnp.max(jnp.where(mesh.tmask[:, None], vs_c, NEG_INF), axis=1)
-    sel = (vs_c == tmax_s[:, None]) & jnp.isfinite(tmax_s)[:, None]
-    tsel = jnp.where(sel, vt_c, PRI_MIN)
-    tmax_t = jnp.max(tsel, axis=1)
-    corner_max = sel & (tsel == tmax_t[:, None])
-    mism4 = jnp.concatenate(
-        [has_c[:, k] & ~corner_max[:, k] & mesh.tmask for k in range(4)])
-    contested = jnp.zeros(capP + 1, bool).at[idx4c].max(
-        mism4, mode="drop")[:capP]
+        # --- ball-quality gate ----------------------------------------------
+        # Simulate the surviving ball of each removal target and compare min
+        # qualities (dying tets drop out).  Normal mode: the collapse must not
+        # degrade the ball min quality below 30% of its old value nor below
+        # the degeneracy floor (MMG5_colver's calnew/calold check — without
+        # it, aggressive coarsening flattens boundary regions into
+        # zero-volume slivers that interior-only swaps never repair).  Sliver
+        # mode: STRICT improvement (the pass exists to raise the min).
+        from .quality import quality_from_points
+        mq = None if met.ndim == 1 else met[tv]
+        # q_tet is a closure variable in sliver mode — don't shadow it
+        q_ball = quality_from_points(vpos, mq) if sliver_q is None \
+            else q_tet
+        idx4c = jnp.concatenate(
+            [jnp.where(mesh.tmask, tv[:, k], capP) for k in range(4)])
+        ballq_old = jnp.full(capP + 1, jnp.inf).at[idx4c].min(
+            jnp.tile(jnp.where(mesh.tmask, q_ball, jnp.inf), 4),
+            mode="drop")
+        # the 4 moved-corner variants as ONE stacked quality call + scatter
+        variants = jnp.concatenate(
+            [vpos.at[:, k].set(kept_pos[:, k]) for k in range(4)])
+        mq4 = None if mq is None else jnp.concatenate(
+            [mq.at[:, k].set(met[kept[:, k]]) for k in range(4)])
+        qv = quality_from_points(variants, mq4)                # [4T]
+        act4 = jnp.concatenate(act_all)
+        ballq_new = jnp.full(capP + 1, jnp.inf).at[idx_act].min(
+            jnp.where(act4, qv, jnp.inf), mode="drop")
+        if sliver_q is None:
+            ok = (ballq_new[:capP] >= 0.3 * ballq_old[:capP]) & \
+                 (ballq_new[:capP] > QUAL_FLOOR)
+            geombad = geombad | ~ok
+        else:
+            improves = ballq_new[:capP] > ballq_old[:capP]
+            geombad = geombad | ~improves
 
-    # vertex claims: a winner must be the (s,t)-max among all candidate
-    # edges touching either of its endpoints (both roles) — one
-    # concatenated scatter per channel
-    idx_rk = jnp.concatenate([jnp.where(cand, rm, capP),
-                              jnp.where(cand, kp, capP)])
-    cl_s = jnp.full(capP + 1, NEG_INF).at[idx_rk].max(
-        jnp.tile(s, 2), mode="drop")
-    eq_rm = cand & (s == cl_s[rm])
-    eq_kp = cand & (s == cl_s[kp])
-    idx_rk2 = jnp.concatenate([jnp.where(eq_rm, rm, capP),
-                               jnp.where(eq_kp, kp, capP)])
-    cl_t = jnp.full(capP + 1, PRI_MIN).at[idx_rk2].max(
-        jnp.tile(t, 2), mode="drop")
-    claim_ok = eq_rm & (t == cl_t[rm]) & eq_kp & (t == cl_t[kp])
+        # --- claims (two-channel, sort-free) ---------------------------------
+        # tet claim = (s,t)-max removal target over the 4 corners; a corner
+        # with a target loses its tets if it is not the tet's max holder
+        tmax_s = jnp.max(jnp.where(mesh.tmask[:, None], vs_c, NEG_INF), axis=1)
+        sel = (vs_c == tmax_s[:, None]) & jnp.isfinite(tmax_s)[:, None]
+        tsel = jnp.where(sel, vt_c, PRI_MIN)
+        tmax_t = jnp.max(tsel, axis=1)
+        corner_max = sel & (tsel == tmax_t[:, None])
+        mism4 = jnp.concatenate(
+            [has_c[:, k] & ~corner_max[:, k] & mesh.tmask for k in range(4)])
+        contested = jnp.zeros(capP + 1, bool).at[idx4c].max(
+            mism4, mode="drop")[:capP]
 
-    win = cand & is_top & ~geombad[rm] & ~contested[rm] & claim_ok
+        # vertex claims: a winner must be the (s,t)-max among all candidate
+        # edges touching either of its endpoints (both roles) — one
+        # concatenated scatter per channel
+        idx_rk = jnp.concatenate([jnp.where(cand, rm, capP),
+                                  jnp.where(cand, kp, capP)])
+        cl_s = jnp.full(capP + 1, NEG_INF).at[idx_rk].max(
+            jnp.tile(s, 2), mode="drop")
+        eq_rm = cand & (s == cl_s[rm])
+        eq_kp = cand & (s == cl_s[kp])
+        idx_rk2 = jnp.concatenate([jnp.where(eq_rm, rm, capP),
+                                   jnp.where(eq_kp, kp, capP)])
+        cl_t = jnp.full(capP + 1, PRI_MIN).at[idx_rk2].max(
+            jnp.tile(t, 2), mode="drop")
+        claim_ok = eq_rm & (t == cl_t[rm]) & eq_kp & (t == cl_t[kp])
 
-    # --- apply: vertex remap + dead shell tets ---------------------------
+        win = cand & is_top & ~geombad[rm] & ~contested[rm] & claim_ok
+        ncol = jnp.sum(win.astype(jnp.int32))
+
+        # --- apply: vertex remap + dead shell tets ---------------------------
+        # the whole apply phase (remap gather, dup detection, keyed tag
+        # joins — 3 full-width sorts) is lax.cond-skipped when the wave has
+        # no winner: near convergence most waves are empty and the apply
+        # cost would dominate the cycle for nothing
+        def _apply_collapse(_):
+            return _collapse_apply(mesh, met, win, rm, kp, capT, capP)
+
+        def _skip_collapse(_):
+            return (mesh.tet, mesh.tmask, mesh.vmask, mesh.ftag, mesh.fref,
+                    mesh.etag)
+
+        new_tet, tmask, vmask, ftag, fref, etag = jax.lax.cond(
+            ncol > 0, _apply_collapse, _skip_collapse, None)
+
+        out = dataclasses.replace(
+            mesh, tet=new_tet, tmask=tmask, vmask=vmask, ftag=ftag,
+            fref=fref, etag=etag)
+        return CollapseResult(out, ncol)
+
+    return jax.lax.cond(jnp.any(pre), _act, _idle, None)
+
+
+def _collapse_apply(mesh: Mesh, met, win, rm, kp, capT, capP):
+    """Apply phase of collapse_wave (see there): vertex remap, dead-tet
+    detection, and the donor tag/ref keyed joins."""
     remap = jnp.arange(capP, dtype=jnp.int32)
     remap = remap.at[jnp.where(win, rm, capP)].set(
         kp, mode="drop", unique_indices=True)   # winners exclusive at rm
@@ -291,6 +346,26 @@ def collapse_wave(mesh: Mesh, met: jax.Array, lmin: float = LSHRT,
     tmask = mesh.tmask & ~dead
     vmask = mesh.vmask.at[jnp.where(win, rm, capP)].set(False, mode="drop")
 
+    # Donor joins are themselves cond-skipped when no dying tet carries
+    # any face/edge tag or face ref — interior collapses (the bulk of a
+    # sizing run) then skip all 3 join sorts.
+    has_donor_info = jnp.any(
+        dead[:, None] & ((mesh.ftag != 0) | (mesh.fref != 0))) | \
+        jnp.any(jnp.repeat(dead, 6) & (mesh.etag.reshape(-1) != 0))
+
+    def _joins(_):
+        return _collapse_tag_joins(mesh, new_tet, dead, tmask, capT, capP)
+
+    def _no_joins(_):
+        return mesh.ftag, mesh.fref, mesh.etag
+
+    ftag, fref, etag = jax.lax.cond(has_donor_info, _joins, _no_joins,
+                                    None)
+    return new_tet, tmask, vmask, ftag, fref, etag
+
+
+def _collapse_tag_joins(mesh: Mesh, new_tet, dead, tmask, capT, capP):
+    """Keyed face/edge tag-transfer joins (see collapse_wave docstring)."""
     # --- transfer face tags/refs from dying tets: keyed face join --------
     # Every face of the REMAPPED mesh is keyed by its sorted vertex
     # triple; dying tets donate their old tags/refs, alive slots with the
@@ -383,9 +458,4 @@ def collapse_wave(mesh: Mesh, met: jax.Array, lmin: float = LSHRT,
     add = jnp.zeros(capT * 6, jnp.uint32).at[order].set(
         add_sorted, unique_indices=True).reshape(capT, 6)
     etag = jnp.where(tmask[:, None], mesh.etag | add, mesh.etag)
-
-    ncol = jnp.sum(win.astype(jnp.int32))
-    out = dataclasses.replace(
-        mesh, tet=new_tet, tmask=tmask, vmask=vmask, ftag=ftag, fref=fref,
-        etag=etag)
-    return CollapseResult(out, ncol)
+    return ftag, fref, etag

@@ -101,13 +101,15 @@ class EdgeTable(NamedTuple):
     etag: jax.Array     # [capE] uint32
     nshell: jax.Array   # [capE] int32
     edge_id: jax.Array  # [capT, 6] int32
-    shell3: jax.Array   # [capE, 3] int32 first 3 shell tet ids (-1 unused)
+    shell3: jax.Array   # [capE, S] int32 first S shell tet ids (-1 unused;
+    #                     S = 3 by default, wider for the generalized swaps
+    #                     — see unique_edges(shell_slots=...))
     shell_rank: jax.Array  # [capT, 6] int32 rank of this tet in the edge's
     #                     shell (ascending tet id) — free by-product of the
     #                     sort; lets split_wave skip its own ranking sort
 
 
-def unique_edges(mesh: Mesh) -> EdgeTable:
+def unique_edges(mesh: Mesh, shell_slots: int = 3) -> EdgeTable:
     capT = mesh.capT
     ev = tet_edge_vertices(mesh.tet).reshape(capT * 6, 2)
     a = jnp.minimum(ev[:, 0], ev[:, 1])
@@ -147,13 +149,15 @@ def unique_edges(mesh: Mesh) -> EdgeTable:
     etag = jnp.zeros(n6, jnp.uint32).at[
         jnp.where(is_last, eid_sorted, n6)].set(
         or_scan, mode="drop", unique_indices=True)
-    # first-3 shell tet ids per edge (for 3-2 swaps): rank within segment
+    # first-S shell tet ids per edge (3 for the 3-2 swap; 6-7 for the
+    # generalized ring swaps): rank within segment
     pos = jnp.arange(capT * 6)
     rank = pos - seg_head
     tet_of_slot = (order // 6).astype(jnp.int32)
-    shell3 = jnp.full((capT * 6, 3), -1, jnp.int32)
-    tgt_e = jnp.where(valid[order] & (rank < 3), eid_sorted, capT * 6)
-    shell3 = shell3.at[tgt_e, jnp.clip(rank, 0, 2)].set(
+    shell3 = jnp.full((capT * 6, shell_slots), -1, jnp.int32)
+    tgt_e = jnp.where(valid[order] & (rank < shell_slots), eid_sorted,
+                      capT * 6)
+    shell3 = shell3.at[tgt_e, jnp.clip(rank, 0, shell_slots - 1)].set(
         tet_of_slot, mode="drop", unique_indices=True)
     # per (tet, local edge) slot: rank of the tet within its edge's shell.
     # The stable lexsort keeps equal keys in slot order (= ascending tet

@@ -109,12 +109,33 @@ def locate_points(bg: Mesh, points: jax.Array, start: jax.Array,
         best = jnp.argmax(score)
         return best.astype(jnp.int32), bar[best]
 
-    # run fallback for every point but only *use* it where failed (keeps
-    # shapes static; cost bounded by doing it in one batched pass)
-    fb_t, fb_b = jax.vmap(exhaustive)(points)
-    tids = jnp.where(failed, fb_t, tids)
-    bary = jnp.where(failed[:, None], fb_b, bary)
+    # Exhaustive fallback only for the points whose walk FAILED, and in
+    # chunks bounding the [chunk, capT] intermediates (the reference
+    # runs its exhaustive pass per failed walk too, locate_pmmg.c:737).
+    # An all-points batched pass materializes [M, capT] under vmap —
+    # tens of GB at the 1M-10M-tet target.  Host-level subsetting is
+    # fine: every caller is a host driver function.
+    import numpy as np
+    fidx = np.where(np.asarray(failed))[0]
+    if len(fidx):
+        fb_t, fb_b = _chunked_vmap(exhaustive, points[fidx],
+                                   _fallback_chunk(capT))
+        tids = tids.at[fidx].set(fb_t)
+        bary = bary.at[fidx].set(fb_b)
     return LocateResult(tids, bary, failed, steps)
+
+
+def _fallback_chunk(nf: int) -> int:
+    """Points per exhaustive-fallback chunk: bounds the [chunk, nf]
+    vmap intermediates to ~2^24 elements (<=200 MB of f32 temporaries)."""
+    return max(1, (1 << 24) // max(nf, 1))
+
+
+def _chunked_vmap(fn, pts, chunk: int):
+    """vmap ``fn`` over points in host-side chunks (memory-bounded)."""
+    outs = [jax.vmap(fn)(pts[i: i + chunk])
+            for i in range(0, pts.shape[0], chunk)]
+    return tuple(jnp.concatenate(parts) for parts in zip(*outs))
 
 
 # ---------------------------------------------------------------------------
@@ -283,9 +304,16 @@ def locate_points_bdy(bg: Mesh, points: jax.Array,
         cb = jnp.clip(bar, 0.0, 1.0)
         cb = cb / jnp.maximum(jnp.sum(cb), EPSD)
         dclip = jnp.linalg.norm(pt - cb @ v)
-        return t, bar, dist, ~ok, dclip
+        # landing-triangle diameter: the wrong-side detector (a correct
+        # landing has dclip ~ hausd << diam; a wrong-side landing is at
+        # body-thickness distance)
+        diam = jnp.sqrt(jnp.maximum(jnp.maximum(
+            jnp.sum((v[1] - v[0]) ** 2), jnp.sum((v[2] - v[0]) ** 2)),
+            jnp.sum((v[2] - v[1]) ** 2)))
+        return t, bar, dist, ~ok, dclip, diam
 
-    tids, bary, dist, failed, dwalk = jax.vmap(walk_one)(points, start)
+    tids, bary, dist, failed, dwalk, diam = jax.vmap(walk_one)(points,
+                                                              start)
 
     # exhaustive closest-triangle fallback (locate_pmmg.c:737 flavor):
     # clip barycentrics to the simplex, evaluate the clipped point, take
@@ -306,15 +334,37 @@ def locate_points_bdy(bg: Mesh, points: jax.Array,
         best = jnp.argmin(d)
         return best.astype(jnp.int32), cb[best], jnp.sqrt(d[best])
 
-    fb_t, fb_b, fb_d = jax.vmap(exhaustive)(points)
-    # the closest triangle is authoritative whenever it is meaningfully
+    # The closest triangle is authoritative whenever it is meaningfully
     # closer than the walk's landing spot (wrong-side landings on closed
     # surfaces); the walk is the accelerator, not the arbiter — the
-    # role split of PMMG_locatePointBdy + closest-tria fallback
-    use_fb = failed | (dwalk > fb_d * (1.0 + 1e-3) + 1e-12)
-    tids = jnp.where(use_fb, fb_t, tids)
-    bary = jnp.where(use_fb[:, None], fb_b, bary)
-    dist = jnp.where(use_fb, fb_d, dist)
+    # role split of PMMG_locatePointBdy + closest-tria fallback.
+    # The exhaustive pass runs ONLY on suspect points — failed walks and
+    # landings farther from the surface than a fraction of the landing
+    # triangle's diameter (a correct landing sits within ~hausd of its
+    # triangle; a wrong-side landing is at body-thickness distance) —
+    # and in chunks bounding the [chunk, F] vmap intermediates.  An
+    # all-points batched pass is tens-to-hundreds of GB at the 1M-tet
+    # target.  Host subsetting is fine: every caller is a host driver.
+    # Threshold tradeoff: a wrong-side landing closer than 5% of the
+    # landing triangle's diameter escapes arbitration — that needs wall
+    # thickness < 0.05x the local surface triangle size, i.e. a surface
+    # mesh that does not resolve the wall it bounds (the volume walk is
+    # equally ambiguous there).  Correct landings sit within ~hausd
+    # (<< 1e-2 diam) of their triangle.
+    import numpy as np
+    suspect = failed | (dwalk > 0.05 * diam + 1e-12)
+    sidx = np.where(np.asarray(suspect))[0]
+    use_fb = jnp.zeros(points.shape[0], bool)
+    if len(sidx):
+        fb_t, fb_b, fb_d = _chunked_vmap(
+            exhaustive, points[sidx], _fallback_chunk(tri.shape[0]))
+        use_s = failed[sidx] | (dwalk[sidx] > fb_d * (1.0 + 1e-3)
+                                + 1e-12)
+        tids = tids.at[sidx].set(jnp.where(use_s, fb_t, tids[sidx]))
+        bary = bary.at[sidx].set(
+            jnp.where(use_s[:, None], fb_b, bary[sidx]))
+        dist = dist.at[sidx].set(jnp.where(use_s, fb_d, dist[sidx]))
+        use_fb = use_fb.at[sidx].set(use_s)
     return SurfLocateResult(tids, bary, dist, use_fb)
 
 
@@ -371,6 +421,7 @@ def interpolate_from_background(bg: Mesh, bg_met: jax.Array,
 
     Returns (met', fields' or None, LocateResult).
     """
+    import numpy as np
     from ..core.constants import MG_BDY
     sel = mesh.vmask if only_new is None else (only_new & mesh.vmask)
     pts = mesh.vert
@@ -378,30 +429,29 @@ def interpolate_from_background(bg: Mesh, bg_met: jax.Array,
         start = jnp.zeros(mesh.capP, jnp.int32)
     loc = locate_points(bg, pts, start)
     on_bdy = (mesh.vtag & MG_BDY) != 0
-    # host-level guard (this is a host-driver function, not jitted): skip
-    # the surface pass entirely when no query vertex is on the boundary
-    use_surf = bool(jnp.any(on_bdy & sel))
-    sloc = locate_points_bdy(bg, pts) if use_surf else None
+    # the surface pass runs on the boundary-selected SUBSET only (this
+    # is a host driver function): feeding all capP rows — dead slots
+    # and interior points included — would send them through the
+    # surface walk + closest-triangle machinery for nothing
+    bidx = np.where(np.asarray(on_bdy & sel))[0]
+    sloc = locate_points_bdy(bg, pts[bidx]) if len(bidx) else None
     if bg_met.ndim == 1:
         met_i = interp_p1(bg_met, bg.tet, loc)
-        met_b = interp_p1_tri(bg_met, bg, sloc) if use_surf else None
+        met_b = interp_p1_tri(bg_met, bg, sloc) \
+            if sloc is not None else None
     else:
         met_i = interp_metric_ani(bg_met, bg.tet, loc)
         met_b = interp_metric_ani_tri(bg_met, bg, sloc) \
-            if use_surf else None
-    if use_surf:
-        met_i = jnp.where(
-            on_bdy.reshape(on_bdy.shape + (1,) * (met_i.ndim - 1)),
-            met_b, met_i)
+            if sloc is not None else None
+    if sloc is not None:
+        met_i = met_i.at[bidx].set(met_b.astype(met_i.dtype))
     met_out = jnp.where(sel.reshape(sel.shape + (1,) * (met.ndim - 1)),
                         met_i.astype(met.dtype), met)
     fields_out = None
     if bg_fields is not None:
         f_i = interp_p1(bg_fields, bg.tet, loc)
-        if use_surf:
+        if sloc is not None:
             f_b = interp_p1_tri(bg_fields, bg, sloc)
-            f_i = jnp.where(
-                on_bdy.reshape(on_bdy.shape + (1,) * (f_i.ndim - 1)),
-                f_b, f_i)
+            f_i = f_i.at[bidx].set(f_b.astype(f_i.dtype))
         fields_out = f_i
     return met_out, fields_out, loc

@@ -42,7 +42,19 @@ class SmoothResult(NamedTuple):
 
 
 def smooth_wave(mesh: Mesh, met: jax.Array, wave: int = 0,
-                relax: float = 1.0) -> SmoothResult:
+                relax: float = 1.0,
+                opt_q: float | None = None) -> SmoothResult:
+    """One smoothing wave; see module docstring.
+
+    ``opt_q``: optimal-position mode for sliver balls — interior
+    vertices whose ball min quality is below ``opt_q`` propose a move
+    along the HEIGHT direction of their worst incident tet (direct
+    ascent on that tet's quality) instead of the ball centroid; the
+    centroid is blind to the worst member and plateaus exactly where
+    the min needs lifting (Mmg's bad-element relocation in MMG3D_opttyp
+    serves this role).  The relaxation cascade and the exact ball
+    min-quality gate are unchanged.
+    """
     capT, capP = mesh.capT, mesh.capP
     movable_int = mesh.vmask & ((mesh.vtag &
                                  (MG_BDY | MG_REQ | MG_CRN | MG_PARBDY))
@@ -121,6 +133,34 @@ def smooth_wave(mesh: Mesh, met: jax.Array, wave: int = 0,
     minq_old = jnp.full(capP + 1, jnp.inf, mesh.vert.dtype).at[idx4].min(
         jnp.tile(jnp.where(mesh.tmask, q_old, jnp.inf), 4), mode="drop")
     minq_old = minq_old[:capP]
+
+    if opt_q is not None:
+        # worst-incident-tet height ascent: for each (tet, corner) whose
+        # tet attains the vertex's ball minimum, the perpendicular from
+        # the opposite face plane to the corner is the quality gradient
+        # direction (moving +d doubles that tet's height); ties average.
+        sworst = jnp.where(mesh.tmask, -q_old, -jnp.inf)
+        vworst = jnp.full(capP + 1, -jnp.inf, mesh.vert.dtype).at[
+            idx4].max(jnp.tile(sworst, 4), mode="drop")[:capP]
+        dacc = jnp.zeros((capP + 1, 4), mesh.vert.dtype)
+        for k in range(4):
+            fidx = idir[k]                                 # face opp k
+            p0 = vpos[:, fidx[0]]
+            nrm = jnp.cross(vpos[:, fidx[1]] - p0, vpos[:, fidx[2]] - p0)
+            n2 = jnp.maximum(jnp.sum(nrm * nrm, -1, keepdims=True), EPSD)
+            d = nrm * (jnp.sum((vpos[:, k] - p0) * nrm, -1,
+                               keepdims=True) / n2)        # [T,3]
+            is_w = mesh.tmask & (sworst >= vworst[tv[:, k]])
+            pay = jnp.concatenate(
+                [jnp.where(is_w[:, None], d, 0.0),
+                 is_w[:, None].astype(mesh.vert.dtype)], axis=1)
+            dacc = dacc.at[jnp.where(is_w, tv[:, k], capP)].add(
+                pay, mode="drop")
+        cnt = jnp.maximum(dacc[:capP, 3:], 1.0)
+        prop_opt = mesh.vert + dacc[:capP, :3] / cnt
+        use_opt = movable_int & (minq_old < opt_q) & \
+            (dacc[:capP, 3] > 0)
+        prop = jnp.where(use_opt[:, None], prop_opt, prop)
 
     # the 4 per-corner displacement variants are evaluated as ONE stacked
     # quality call per relaxation step (4x batch ~ free, 4 calls are not)

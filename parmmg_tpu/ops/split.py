@@ -42,6 +42,9 @@ class SplitResult(NamedTuple):
     met: jax.Array
     nsplit: jax.Array      # scalar int32: number of edges split
     overflow: jax.Array    # scalar bool: capacity exhausted, wave truncated
+    modified: jax.Array = None  # [capT] bool: tets rewritten/created this
+    #                 wave (consumed by collapse_wave's staleness veto
+    #                 when both ops share one pre-split edge table)
 
 
 def _interp_met_mid(met, va, vb):
@@ -54,7 +57,9 @@ def split_wave(mesh: Mesh, met: jax.Array, lmax: float = LLONG,
                frozen_vtag: int = MG_REQ | MG_PARBDY,
                hausd: float | None = None,
                budget_div: int = 8,
-               fem_only: bool = False) -> SplitResult:
+               fem_only: bool = False,
+               et: EdgeTable | None = None,
+               lens: jax.Array | None = None) -> SplitResult:
     """One independent-set split wave. Jittable; static shapes throughout.
 
     ``hausd`` enables the PLACEMENT half of surface-approximation
@@ -82,10 +87,16 @@ def split_wave(mesh: Mesh, met: jax.Array, lmax: float = LLONG,
     ops/edges.wave_budget formula; winners past it are deferred to the
     next wave, NOT flagged as overflow); the convergence-verification
     wide cycle passes 2.
+
+    ``et``/``lens``: a caller-precomputed edge table + metric lengths of
+    THIS mesh (adapt_cycle_impl builds one table serving both split and
+    collapse — the tables are a measured hot spot of every wave).
     """
     capT, capP = mesh.capT, mesh.capP
-    et = unique_edges(mesh)
-    lens = edge_lengths(mesh, et, met)
+    if et is None:
+        et = unique_edges(mesh)
+    if lens is None:
+        lens = edge_lengths(mesh, et, met)
 
     # --- candidate edges -------------------------------------------------
     va = jnp.clip(et.ev[:, 0], 0, capP - 1)
@@ -116,172 +127,187 @@ def split_wave(mesh: Mesh, met: jax.Array, lmax: float = LLONG,
         # folds sqrt(8*hausd/kappa) into boundary sizes, the Mmg defsiz
         # route); here hausd only drives point PLACEMENT
         lift_corr = jnp.where(regular[:, None], corr, 0.0)
-    s, t = claim_channels(lens, cand)                 # sort-free priority
+    # Everything below (nomination, degeneracy veto, winner
+    # selection, apply) is lax.cond-skipped when NO candidate edge
+    # exists — at convergence the wave then costs only the table +
+    # candidacy masks.
+    def _idle(_):
+        return SplitResult(mesh, met, jnp.zeros((), jnp.int32),
+                           jnp.zeros((), bool),
+                           jnp.zeros(capT, bool))
 
-    # --- nomination: each tet picks its (s,t)-max candidate edge ---------
-    tes = jnp.where(mesh.tmask[:, None], s[et.edge_id], NEG_INF)
-    best_s = jnp.max(tes, axis=1)                     # [capT]
-    at_best = (tes == best_s[:, None]) & jnp.isfinite(best_s)[:, None]
-    tet_t = jnp.where(at_best, t[et.edge_id], PRI_MIN)
-    best_t = jnp.max(tet_t, axis=1)
-    # exactly one slot per tet (t is unique): the whole-shell win test
-    # below stays exact under simultaneous application
-    nominate = at_best & (tet_t == best_t[:, None])
+    def _act(_):
+        s, t = claim_channels(lens, cand)                 # sort-free priority
 
-    # degeneracy veto (MMG5_split1b cavity-quality check): a tet refuses
-    # its nominated edge if either child tet would be degenerate — thin
-    # tets halved at a midpoint can round to exactly zero volume in f32
-    from .quality import quality_from_points
-    from ..core.constants import QUAL_FLOOR
-    ar0 = jnp.arange(capT)
-    loc_n = jnp.argmax(nominate, axis=1)                  # [capT]
-    e_n = et.edge_id[ar0, loc_n]
-    i_n = _IARE_J[loc_n, 0]
-    j_n = _IARE_J[loc_n, 1]
-    mid_n = 0.5 * (mesh.vert[va[e_n]] + mesh.vert[vb[e_n]])
-    if lift_corr is not None:
-        mid_n = mid_n + lift_corr[e_n]
-    pts = mesh.vert[mesh.tet]                             # [T,4,3]
-    q1 = quality_from_points(pts.at[ar0, j_n].set(mid_n))
-    q2 = quality_from_points(pts.at[ar0, i_n].set(mid_n))
-    nominate = nominate & ((q1 > QUAL_FLOOR) & (q2 > QUAL_FLOOR))[:, None]
+        # --- nomination: each tet picks its (s,t)-max candidate edge ---------
+        tes = jnp.where(mesh.tmask[:, None], s[et.edge_id], NEG_INF)
+        best_s = jnp.max(tes, axis=1)                     # [capT]
+        at_best = (tes == best_s[:, None]) & jnp.isfinite(best_s)[:, None]
+        tet_t = jnp.where(at_best, t[et.edge_id], PRI_MIN)
+        best_t = jnp.max(tet_t, axis=1)
+        # exactly one slot per tet (t is unique): the whole-shell win test
+        # below stays exact under simultaneous application
+        nominate = at_best & (tet_t == best_t[:, None])
 
-    # --- an edge wins iff nominated by its whole shell -------------------
-    capE = et.ev.shape[0]
-    nom_count = jnp.zeros(capE, jnp.int32).at[et.edge_id.reshape(-1)].add(
-        nominate.reshape(-1).astype(jnp.int32))
-    win = cand & (nom_count == et.nshell) & (et.nshell > 0)
+        # degeneracy veto (MMG5_split1b cavity-quality check): a tet refuses
+        # its nominated edge if either child tet would be degenerate — thin
+        # tets halved at a midpoint can round to exactly zero volume in f32
+        from .quality import quality_from_points
+        from ..core.constants import QUAL_FLOOR
+        ar0 = jnp.arange(capT)
+        loc_n = jnp.argmax(nominate, axis=1)                  # [capT]
+        e_n = et.edge_id[ar0, loc_n]
+        i_n = _IARE_J[loc_n, 0]
+        j_n = _IARE_J[loc_n, 1]
+        mid_n = 0.5 * (mesh.vert[va[e_n]] + mesh.vert[vb[e_n]])
+        if lift_corr is not None:
+            mid_n = mid_n + lift_corr[e_n]
+        pts = mesh.vert[mesh.tet]                             # [T,4,3]
+        q1 = quality_from_points(pts.at[ar0, j_n].set(mid_n))
+        q2 = quality_from_points(pts.at[ar0, i_n].set(mid_n))
+        nominate = nominate & ((q1 > QUAL_FLOOR) & (q2 > QUAL_FLOOR))[:, None]
 
-    # --- allocate midpoint vertices --------------------------------------
-    win_i = win.astype(jnp.int32)
-    new_off = jnp.cumsum(win_i) - win_i               # prefix index per win
-    nwin = jnp.sum(win_i)
-    free_p = capP - mesh.npoin
-    # capacity guard: drop lowest-priority winners that don't fit
-    fits_p = new_off < free_p
-    # each winning edge adds nshell tets; prefix over shells
-    shell_add = jnp.where(win & fits_p, et.nshell, 0)
-    tet_off = jnp.cumsum(shell_add) - shell_add
-    free_t = capT - mesh.nelem
-    fits_t = (tet_off + shell_add) <= free_t
-    win_cap = win & fits_p & fits_t
-    # overflow = CAPACITY-dropped winners only (triggers a host regrow);
-    # the per-wave budget below just defers winners to the next wave
-    overflow = (nwin > 0) & (jnp.sum(win_cap) < nwin)
-    # per-wave budget: at most KW midpoints / KH shell tets per wave, so
-    # the apply scatters run at [KW]/[KH] width instead of [6*capT]/[capT]
-    # (scatter cost is linear in index count — scripts/wave_time.py).
-    # The cut is by PRIORITY (longest edges first), not slot order — a
-    # slot-order cut would refine the mesh spatially unevenly
-    from .edges import wave_budget
-    KW = min(wave_budget(capT, budget_div), et.ev.shape[0])
-    KH = min(2 * wave_budget(capT, budget_div), capT)
-    bord = jnp.argsort(jnp.where(win_cap, -lens, jnp.inf))
-    win_srt = win_cap[bord]
-    off_srt = jnp.cumsum(win_srt.astype(jnp.int32)) - win_srt
-    sh_srt = jnp.where(win_srt & (off_srt < KW), et.nshell[bord], 0)
-    toff_srt = jnp.cumsum(sh_srt) - sh_srt
-    ok_srt = win_srt & (off_srt < KW) & ((toff_srt + sh_srt) <= KH)
-    win = jnp.zeros_like(win_cap).at[bord].set(ok_srt,
-                                               unique_indices=True)
-    # recompute offsets over the final winner set
-    win_i = win.astype(jnp.int32)
-    new_off = jnp.cumsum(win_i) - win_i
-    shell_add = jnp.where(win, et.nshell, 0)
-    tet_off = jnp.cumsum(shell_add) - shell_add
-    nwin = jnp.sum(win_i)
+        # --- an edge wins iff nominated by its whole shell -------------------
+        capE = et.ev.shape[0]
+        nom_count = jnp.zeros(capE, jnp.int32).at[et.edge_id.reshape(-1)].add(
+            nominate.reshape(-1).astype(jnp.int32))
+        win = cand & (nom_count == et.nshell) & (et.nshell > 0)
 
-    capE = et.ev.shape[0]
-    mid_id = (mesh.npoin + new_off).astype(jnp.int32)  # [capE] vertex slot
-    # midpoint coordinates / refs / tags — computed on the COMPACTED
-    # winner set [KW] (budget above guarantees it fits)
-    widx = jnp.nonzero(win, size=KW, fill_value=capE)[0]
-    wv = widx < capE
-    wc = jnp.clip(widx, 0, capE - 1)
-    va_w, vb_w = va[wc], vb[wc]
-    pa, pb = mesh.vert[va_w], mesh.vert[vb_w]
-    mid = 0.5 * (pa + pb)
-    if lift_corr is not None:
-        mid = mid + lift_corr[wc]             # onto the Bezier surface
-    tgt_w = jnp.where(wv, mid_id[wc], capP)
-    vert = mesh.vert.at[tgt_w].set(mid, mode="drop", unique_indices=True)
-    vmask = mesh.vmask.at[tgt_w].set(True, mode="drop",
-                                     unique_indices=True)
-    # the new point inherits the edge's tags (a point on a ridge edge is a
-    # ridge point, on a boundary edge a boundary point, ...)
-    vtag = mesh.vtag.at[tgt_w].set(et.etag[wc], mode="drop",
-                                   unique_indices=True)
-    vref = mesh.vref.at[tgt_w].set(
-        jnp.minimum(mesh.vref[va_w], mesh.vref[vb_w]), mode="drop",
-        unique_indices=True)
-    met_new = met.at[tgt_w].set(_interp_met_mid(met, va_w, vb_w),
-                                mode="drop", unique_indices=True)
+        # --- allocate midpoint vertices --------------------------------------
+        win_i = win.astype(jnp.int32)
+        new_off = jnp.cumsum(win_i) - win_i               # prefix index per win
+        nwin = jnp.sum(win_i)
+        free_p = capP - mesh.npoin
+        # capacity guard: drop lowest-priority winners that don't fit
+        fits_p = new_off < free_p
+        # each winning edge adds nshell tets; prefix over shells
+        shell_add = jnp.where(win & fits_p, et.nshell, 0)
+        tet_off = jnp.cumsum(shell_add) - shell_add
+        free_t = capT - mesh.nelem
+        fits_t = (tet_off + shell_add) <= free_t
+        win_cap = win & fits_p & fits_t
+        # overflow = CAPACITY-dropped winners only (triggers a host regrow);
+        # the per-wave budget below just defers winners to the next wave
+        overflow = (nwin > 0) & (jnp.sum(win_cap) < nwin)
+        # per-wave budget: at most KW midpoints / KH shell tets per wave, so
+        # the apply scatters run at [KW]/[KH] width instead of [6*capT]/[capT]
+        # (scatter cost is linear in index count — scripts/wave_time.py).
+        # The cut is by PRIORITY (longest edges first), not slot order — a
+        # slot-order cut would refine the mesh spatially unevenly
+        from .edges import wave_budget
+        KW = min(wave_budget(capT, budget_div), et.ev.shape[0])
+        KH = min(2 * wave_budget(capT, budget_div), capT)
+        bord = jnp.argsort(jnp.where(win_cap, -lens, jnp.inf))
+        win_srt = win_cap[bord]
+        off_srt = jnp.cumsum(win_srt.astype(jnp.int32)) - win_srt
+        sh_srt = jnp.where(win_srt & (off_srt < KW), et.nshell[bord], 0)
+        toff_srt = jnp.cumsum(sh_srt) - sh_srt
+        ok_srt = win_srt & (off_srt < KW) & ((toff_srt + sh_srt) <= KH)
+        win = jnp.zeros_like(win_cap).at[bord].set(ok_srt,
+                                                   unique_indices=True)
+        # recompute offsets over the final winner set
+        win_i = win.astype(jnp.int32)
+        new_off = jnp.cumsum(win_i) - win_i
+        shell_add = jnp.where(win, et.nshell, 0)
+        tet_off = jnp.cumsum(shell_add) - shell_add
+        nwin = jnp.sum(win_i)
 
-    # --- split shell tets (compacted to the [KH] affected rows) -----------
-    # per (tet, local edge): is my edge winning, and bookkeeping
-    e_win = win[et.edge_id] & mesh.tmask[:, None]          # [capT,6]
-    # at most one winning edge per tet (guaranteed); its local index:
-    loc_e = jnp.argmax(e_win, axis=1)                      # [capT]
-    has = jnp.any(e_win, axis=1)
-    eid = et.edge_id[jnp.arange(capT), loc_e]              # unique edge id
-    m_id = jnp.clip(mid_id[eid], 0, capP - 1)              # midpoint vid
+        capE = et.ev.shape[0]
+        mid_id = (mesh.npoin + new_off).astype(jnp.int32)  # [capE] vertex slot
+        # midpoint coordinates / refs / tags — computed on the COMPACTED
+        # winner set [KW] (budget above guarantees it fits)
+        widx = jnp.nonzero(win, size=KW, fill_value=capE)[0]
+        wv = widx < capE
+        wc = jnp.clip(widx, 0, capE - 1)
+        va_w, vb_w = va[wc], vb[wc]
+        pa, pb = mesh.vert[va_w], mesh.vert[vb_w]
+        mid = 0.5 * (pa + pb)
+        if lift_corr is not None:
+            mid = mid + lift_corr[wc]             # onto the Bezier surface
+        tgt_w = jnp.where(wv, mid_id[wc], capP)
+        vert = mesh.vert.at[tgt_w].set(mid, mode="drop", unique_indices=True)
+        vmask = mesh.vmask.at[tgt_w].set(True, mode="drop",
+                                         unique_indices=True)
+        # the new point inherits the edge's tags (a point on a ridge edge is a
+        # ridge point, on a boundary edge a boundary point, ...)
+        vtag = mesh.vtag.at[tgt_w].set(et.etag[wc], mode="drop",
+                                       unique_indices=True)
+        vref = mesh.vref.at[tgt_w].set(
+            jnp.minimum(mesh.vref[va_w], mesh.vref[vb_w]), mode="drop",
+            unique_indices=True)
+        met_new = met.at[tgt_w].set(_interp_met_mid(met, va_w, vb_w),
+                                    mode="drop", unique_indices=True)
 
-    # rank of this tet within its shell -> new tet slot.  A winning edge is
-    # nominated by its WHOLE shell, so the shell tets of a winning edge are
-    # exactly the tets whose chosen slot maps to it — the shell rank
-    # precomputed by unique_edges (sorted-segment rank, ascending tet id)
-    # is that rank, no extra sort needed.
-    shell_rank = et.shell_rank[jnp.arange(capT), loc_e]
-    new_tid = (mesh.nelem + tet_off[eid] + shell_rank).astype(jnp.int32)
+        # --- split shell tets (compacted to the [KH] affected rows) -----------
+        # per (tet, local edge): is my edge winning, and bookkeeping
+        e_win = win[et.edge_id] & mesh.tmask[:, None]          # [capT,6]
+        # at most one winning edge per tet (guaranteed); its local index:
+        loc_e = jnp.argmax(e_win, axis=1)                      # [capT]
+        has = jnp.any(e_win, axis=1)
+        eid = et.edge_id[jnp.arange(capT), loc_e]              # unique edge id
+        m_id = jnp.clip(mid_id[eid], 0, capP - 1)              # midpoint vid
 
-    # compacted affected-tet rows (budget KH guaranteed above)
-    hidx = jnp.nonzero(has, size=KH, fill_value=capT)[0]
-    hv = hidx < capT
-    hc = jnp.clip(hidx, 0, capT - 1)
-    arK = jnp.arange(KH)
-    il = _IARE_J[loc_e[hc], 0]                             # [KH]
-    jl = _IARE_J[loc_e[hc], 1]
-    mh = m_id[hc]
-    tgt1 = jnp.where(hv, hidx, capT)
-    tgt2 = jnp.where(hv, new_tid[hc], capT)
-    rows0 = mesh.tet[hc]                                   # [KH,4]
-    # tet1 (in place): vertex j -> m ; tet2 (new slot): vertex i -> m
-    tet1_rows = rows0.at[arK, jl].set(mh, unique_indices=True)
-    tet2_rows = rows0.at[arK, il].set(mh, unique_indices=True)
-    tet_out = mesh.tet.at[tgt1].set(tet1_rows, mode="drop",
-                                    unique_indices=True)
-    tet_out = tet_out.at[tgt2].set(tet2_rows, mode="drop",
-                                   unique_indices=True)
-    tmask = mesh.tmask.at[tgt2].set(True, mode="drop",
-                                    unique_indices=True)
-    tref = mesh.tref.at[tgt2].set(mesh.tref[hc], mode="drop",
-                                  unique_indices=True)
+        # rank of this tet within its shell -> new tet slot.  A winning edge is
+        # nominated by its WHOLE shell, so the shell tets of a winning edge are
+        # exactly the tets whose chosen slot maps to it — the shell rank
+        # precomputed by unique_edges (sorted-segment rank, ascending tet id)
+        # is that rank, no extra sort needed.
+        shell_rank = et.shell_rank[jnp.arange(capT), loc_e]
+        new_tid = (mesh.nelem + tet_off[eid] + shell_rank).astype(jnp.int32)
 
-    # --- tag inheritance (on the compacted rows) --------------------------
-    # tet1 keeps its ftag/etag except: the cut face (opposite i) becomes
-    # interior (tag 0); the half edges adjacent to the cut inherit; new
-    # edges (m,c) inside an old face f inherit that face's boundary bit.
-    ftag1r, fref1r, etag1r, ftag2r, fref2r, etag2r = _split_tags_rows(
-        mesh, hc, il, jl)
-    ftag = mesh.ftag.at[tgt1].set(ftag1r, mode="drop",
-                                  unique_indices=True)
-    ftag = ftag.at[tgt2].set(ftag2r, mode="drop", unique_indices=True)
-    frf = mesh.fref.at[tgt1].set(fref1r, mode="drop",
-                                 unique_indices=True)
-    frf = frf.at[tgt2].set(fref2r, mode="drop", unique_indices=True)
-    etag_out = mesh.etag.at[tgt1].set(etag1r, mode="drop",
+        # compacted affected-tet rows (budget KH guaranteed above)
+        hidx = jnp.nonzero(has, size=KH, fill_value=capT)[0]
+        hv = hidx < capT
+        hc = jnp.clip(hidx, 0, capT - 1)
+        arK = jnp.arange(KH)
+        il = _IARE_J[loc_e[hc], 0]                             # [KH]
+        jl = _IARE_J[loc_e[hc], 1]
+        mh = m_id[hc]
+        tgt1 = jnp.where(hv, hidx, capT)
+        tgt2 = jnp.where(hv, new_tid[hc], capT)
+        rows0 = mesh.tet[hc]                                   # [KH,4]
+        # tet1 (in place): vertex j -> m ; tet2 (new slot): vertex i -> m
+        tet1_rows = rows0.at[arK, jl].set(mh, unique_indices=True)
+        tet2_rows = rows0.at[arK, il].set(mh, unique_indices=True)
+        tet_out = mesh.tet.at[tgt1].set(tet1_rows, mode="drop",
+                                        unique_indices=True)
+        tet_out = tet_out.at[tgt2].set(tet2_rows, mode="drop",
+                                       unique_indices=True)
+        tmask = mesh.tmask.at[tgt2].set(True, mode="drop",
+                                        unique_indices=True)
+        tref = mesh.tref.at[tgt2].set(mesh.tref[hc], mode="drop",
                                       unique_indices=True)
-    etag_out = etag_out.at[tgt2].set(etag2r, mode="drop",
-                                     unique_indices=True)
 
-    npoin = mesh.npoin + nwin
-    nelem = mesh.nelem + jnp.sum(jnp.where(has, 1, 0), dtype=jnp.int32)
-    out = dataclasses.replace(
-        mesh, vert=vert, vmask=vmask, vtag=vtag, vref=vref,
-        tet=tet_out, tmask=tmask, tref=tref,
-        ftag=ftag, fref=frf, etag=etag_out,
-        npoin=npoin.astype(jnp.int32), nelem=nelem.astype(jnp.int32))
-    return SplitResult(out, met_new, nwin, overflow)
+        # --- tag inheritance (on the compacted rows) --------------------------
+        # tet1 keeps its ftag/etag except: the cut face (opposite i) becomes
+        # interior (tag 0); the half edges adjacent to the cut inherit; new
+        # edges (m,c) inside an old face f inherit that face's boundary bit.
+        ftag1r, fref1r, etag1r, ftag2r, fref2r, etag2r = _split_tags_rows(
+            mesh, hc, il, jl)
+        ftag = mesh.ftag.at[tgt1].set(ftag1r, mode="drop",
+                                      unique_indices=True)
+        ftag = ftag.at[tgt2].set(ftag2r, mode="drop", unique_indices=True)
+        frf = mesh.fref.at[tgt1].set(fref1r, mode="drop",
+                                     unique_indices=True)
+        frf = frf.at[tgt2].set(fref2r, mode="drop", unique_indices=True)
+        etag_out = mesh.etag.at[tgt1].set(etag1r, mode="drop",
+                                          unique_indices=True)
+        etag_out = etag_out.at[tgt2].set(etag2r, mode="drop",
+                                         unique_indices=True)
+
+        npoin = mesh.npoin + nwin
+        nelem = mesh.nelem + jnp.sum(jnp.where(has, 1, 0), dtype=jnp.int32)
+        out = dataclasses.replace(
+            mesh, vert=vert, vmask=vmask, vtag=vtag, vref=vref,
+            tet=tet_out, tmask=tmask, tref=tref,
+            ftag=ftag, fref=frf, etag=etag_out,
+            npoin=npoin.astype(jnp.int32), nelem=nelem.astype(jnp.int32))
+        # tets rewritten in place (has) or created (tgt2 slots) this wave —
+        # the staleness footprint for a collapse sharing our edge table
+        modified = has.at[tgt2].set(True, mode="drop", unique_indices=True)
+        return SplitResult(out, met_new, nwin, overflow, modified)
+
+    return jax.lax.cond(jnp.any(cand), _act, _idle, None)
 
 
 def _split_tags_rows(mesh: Mesh, hc, il, jl):

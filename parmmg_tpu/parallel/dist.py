@@ -112,6 +112,65 @@ def dist_adapt_cycle(dmesh: DeviceMesh, do_swap: bool = True,
     return jax.jit(fn)
 
 
+def dist_adapt_block(dmesh: DeviceMesh, swap_flags: tuple,
+                     do_smooth: bool = True, do_insert: bool = True,
+                     hausd: float | None = None):
+    """SPMD fused cycle block: ``len(swap_flags)`` adapt cycles in ONE
+    jitted shard_map program — the production analogue of
+    ops.adapt.adapt_cycles_fused.  One dispatch + one psum'd counter
+    pull per block instead of per cycle: on the tunneled chip each
+    dispatch pays a ~70-110 ms transport round trip.
+
+    Returns fn(stacked_mesh, stacked_met, wave0) ->
+      (stacked_mesh, stacked_met, global_counts[n,4], any_overflow).
+    """
+    from ..ops.adapt import adapt_cycle_impl
+    spec = P("shard")
+
+    def local_block(mesh_s: Mesh, met_s, wave0):
+        mesh = _unstack(mesh_s)
+        met = met_s[0]
+        counts_all = []
+        for c, dosw in enumerate(swap_flags):
+            mesh, met, counts = adapt_cycle_impl(
+                mesh, met, wave0 + c, do_swap=dosw, do_smooth=do_smooth,
+                do_insert=do_insert, smooth_waves=2, hausd=hausd,
+                final_rebuild=(c == len(swap_flags) - 1))
+            counts_all.append(counts)
+        cs = jnp.stack(counts_all)                         # [n, 6]
+        ovf = jax.lax.pmax(jnp.max(cs[:, 4]), "shard")
+        counts = jax.lax.psum(cs[:, :4], "shard")
+        return _restack(mesh), met[None], counts, ovf
+
+    fn = shard_map(local_block, mesh=dmesh,
+                   in_specs=(spec, spec, P()),
+                   out_specs=(spec, spec, P(), P()),
+                   check_vma=False)
+    return jax.jit(fn)
+
+
+class DistSteps:
+    """Per-driver-invocation cache of compiled SPMD block programs keyed
+    by the swap-flag tuple.  jax.jit caches by function identity, so a
+    fresh shard_map per outer iteration would recompile the multi-minute
+    SPMD graph every time; the multi-iteration drivers build ONE of
+    these and reuse it."""
+
+    def __init__(self, dmesh: DeviceMesh, do_smooth: bool = True,
+                 do_insert: bool = True, hausd: float | None = None):
+        self.dmesh = dmesh
+        self.kw = dict(do_smooth=do_smooth, do_insert=do_insert,
+                       hausd=hausd)
+        self._cache: dict = {}
+
+    def get(self, flags: tuple):
+        flags = tuple(bool(f) for f in flags)
+        if flags not in self._cache:
+            self._cache[flags] = dist_adapt_block(self.dmesh, flags,
+                                                  **self.kw)
+        return self._cache[flags]
+
+
 def dist_interface_check(dmesh: DeviceMesh):
     """On-device interface echo (PMMG_check_extNodeComm on the jittable
     exchange): every shard sends its interface vertices' coordinates +
@@ -277,9 +336,10 @@ def check_interface_echo(stacked, met_s, comms, dmesh, vert_h):
             "(ordering contract violated)")
 
 
-def run_adapt_cycles(stacked, met_s, step_full, step_light, cycles,
+def run_adapt_cycles(stacked, met_s, steps: DistSteps, cycles,
                      dmesh, stats=None, verbose=0, on_grow=None,
-                     regrow_state=None, label="dist"):
+                     regrow_state=None, label="dist", noswap=False,
+                     block=None):
     """Shared SPMD cycle loop: swap cadence (every 3rd cycle + the final
     two), psum'd counter accounting, and the in-place overflow regrow
     (zaldy_pmmg.c:140-254 analogue — slot ids preserved so comm tables
@@ -287,31 +347,42 @@ def run_adapt_cycles(stacked, met_s, step_full, step_light, cycles,
     ShardOverflowError carrying the conforming merged state
     (failed_handling, libparmmg1.c:974-1011).
 
+    Cycles dispatch in fused blocks (default_cycle_block: 3 on TPU, 1
+    elsewhere) — one transport round trip + one counter pull per block,
+    the same amortization bench.py measures.
+
     ``on_grow(old_capP)`` lets the caller grow its side tables (global
     numbering) in lockstep; ``regrow_state`` is a 1-element mutable list
     carried across calls so repeated passes share the regrow budget.
     """
     from .distribute import merge_shards, grow_shards
+    from ..ops.adapt import default_cycle_block
     if regrow_state is None:
         regrow_state = [0]
+    if block is None:
+        block = default_cycle_block(stacked.vert)
     c = 0
     while c < cycles:
+        nblk = min(block, cycles - c)
         # swaps every 3rd cycle (see ops.adapt.adapt_mesh) and on the
         # final two (quality polish before the merge/migration)
-        step = step_full if (c % 3 == 2 or c >= cycles - 2) \
-            else step_light
+        flags = tuple((cc % 3 == 2 or cc >= cycles - 2) and not noswap
+                      for cc in range(c, c + nblk))
+        step = steps.get(flags)
         stacked, met_s, counts, ovf = step(stacked, met_s,
                                            jnp.asarray(c, jnp.int32))
-        cs = np.asarray(counts)
-        if stats is not None:        # psum'd global counters
-            stats.nsplit += int(cs[0])
-            stats.ncollapse += int(cs[1])
-            stats.nswap += int(cs[2])
-            stats.nmoved += int(cs[3])
-            stats.cycles += 1
-        if verbose >= 3:
-            print(f"  {label} cycle {c}: split {cs[0]} collapse {cs[1]} "
-                  f"swap {cs[2]} move {cs[3]}")
+        ca = np.asarray(counts)                  # [nblk, 4]
+        for i in range(nblk):
+            cs = ca[i]
+            if stats is not None:        # psum'd global counters
+                stats.nsplit += int(cs[0])
+                stats.ncollapse += int(cs[1])
+                stats.nswap += int(cs[2])
+                stats.nmoved += int(cs[3])
+                stats.cycles += 1
+            if verbose >= 3:
+                print(f"  {label} cycle {c + i}: split {cs[0]} "
+                      f"collapse {cs[1]} swap {cs[2]} move {cs[3]}")
         if int(ovf) != 0:
             if regrow_state[0] >= MAX_SHARD_REGROWS:
                 m_, k_, p_ = merge_shards(stacked, met_s,
@@ -326,10 +397,13 @@ def run_adapt_cycles(stacked, met_s, step_full, step_light, cycles,
             if on_grow is not None:
                 on_grow(capP)
             regrow_state[0] += 1
-            continue
-        c += 1
-        if step is step_full and cs[0] == 0 and cs[1] == 0 \
-                and cs[2] == 0:
+            continue        # re-run the block: truncated winners rerun
+        c += nblk
+        # convergence: a swap-inclusive (or noswap) cycle with zero
+        # topological ops ends the pass
+        if any((flags[i] or noswap) and
+               int(ca[i][0]) + int(ca[i][1]) + int(ca[i][2]) == 0
+               for i in range(nblk)):
             break
     return stacked, met_s
 
@@ -379,14 +453,8 @@ def distributed_adapt(mesh: Mesh, met, n_shards: int,
         part = fix_contiguity(tet, refine_partition(
             part, n_shards, wd["pairs"], wd["w"]))
 
-    step_full = dist_adapt_cycle(dmesh, do_swap=not noswap,
-                                 do_smooth=not nomove,
-                                 do_insert=not noinsert, hausd=hausd)
-    # with -noswap both flavors are the same program: don't compile the
-    # multi-minute SPMD graph twice
-    step_light = step_full if noswap else dist_adapt_cycle(
-        dmesh, do_swap=False, do_smooth=not nomove,
-        do_insert=not noinsert, hausd=hausd)
+    steps = DistSteps(dmesh, do_smooth=not nomove,
+                      do_insert=not noinsert, hausd=hausd)
     vert_h, tet_h = vert, tet
     s, ms, l2g = split_to_shards(mesh, met, part, n_shards,
                                  cap_mult=3.0, return_l2g=True)
@@ -405,8 +473,8 @@ def distributed_adapt(mesh: Mesh, met, n_shards: int,
     comms = build_interface_comms(tet_h, part, n_shards, l2g, g2l)
     check_interface_echo(stacked, met_s, comms, dmesh, vert_h)
     stacked, met_s = run_adapt_cycles(
-        stacked, met_s, step_full, step_light, cycles, dmesh,
-        stats=stats, verbose=verbose)
+        stacked, met_s, steps, cycles, dmesh,
+        stats=stats, verbose=verbose, noswap=noswap)
     # cross-shard surface analysis refresh (PMMG_update_analys analogue)
     # BEFORE the merge: ridge/corner/ref classification with
     # cross-interface dihedrals, written into the shard tags so the
@@ -501,12 +569,8 @@ def distributed_adapt_multi(mesh: Mesh, met, n_shards: int,
 
     check_interface_echo(stacked, met_s, comms, dmesh, vert_h)
 
-    step_full = dist_adapt_cycle(dmesh, do_swap=not noswap,
-                                 do_smooth=not nomove,
-                                 do_insert=not noinsert, hausd=hausd)
-    step_light = step_full if noswap else dist_adapt_cycle(
-        dmesh, do_swap=False, do_smooth=not nomove,
-        do_insert=not noinsert, hausd=hausd)
+    steps = DistSteps(dmesh, do_smooth=not nomove,
+                      do_insert=not noinsert, hausd=hausd)
 
     def grow_glo(old_capP):
         # keep the global-numbering tables in lockstep with a device
@@ -518,9 +582,10 @@ def distributed_adapt_multi(mesh: Mesh, met, n_shards: int,
     regrow_state = [0]
     for it in range(max(1, niter)):
         stacked, met_s = run_adapt_cycles(
-            stacked, met_s, step_full, step_light, cycles, dmesh,
+            stacked, met_s, steps, cycles, dmesh,
             stats=stats, verbose=verbose, on_grow=grow_glo,
-            regrow_state=regrow_state, label=f"dist it {it}")
+            regrow_state=regrow_state, label=f"dist it {it}",
+            noswap=noswap)
         # host views: ONE consolidated pull serving analysis + migration
         views = pull_views(stacked, met_s)
         top = extend_global_ids(glo, views, top)

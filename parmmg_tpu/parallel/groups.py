@@ -55,7 +55,7 @@ def grouped_adapt_pass(mesh: Mesh, met, ngroups: int, cycles: int = 12,
     path (frozen MG_PARBDY group seams make it correct); the map axis
     serializes groups so HBM holds one group's working set at a time.
     """
-    from ..ops.adapt import adapt_cycle_impl
+    from ..ops.adapt import adapt_cycle_impl, default_cycle_block
     from .partition import morton_partition, fix_contiguity
     from .distribute import split_to_shards, merge_shards, grow_shards
     from ..core.mesh import mesh_to_host
@@ -67,44 +67,55 @@ def grouped_adapt_pass(mesh: Mesh, met, ngroups: int, cycles: int = 12,
     stacked, met_s = split_to_shards(mesh, met, part, ngroups,
                                      cap_mult=3.0)
 
-    def one_cycle(do_swap: bool, do_smooth: bool, do_insert: bool):
+    def one_block(flags: tuple):
+        # fused cycle block inside the lax.map body: one dispatch + one
+        # counter pull per block per outer step (ops.adapt
+        # adapt_cycles_fused analogue for the group axis)
         def body(args):
             m, k, wave = args
-            m, k, counts = adapt_cycle_impl(
-                m, k, wave, do_swap=do_swap, do_smooth=do_smooth,
-                do_insert=do_insert, hausd=hausd)
-            return m, k, counts
+            counts_all = []
+            for cc, dosw in enumerate(flags):
+                m, k, counts = adapt_cycle_impl(
+                    m, k, wave + cc, do_swap=dosw,
+                    do_smooth=not nomove, do_insert=not noinsert,
+                    hausd=hausd, final_rebuild=(cc == len(flags) - 1))
+                counts_all.append(counts)
+            return m, k, jnp.stack(counts_all)       # [n, 6]
 
         @jax.jit
         def run(stacked, met_s, wave):
             waves = jnp.full(ngroups, wave, jnp.int32)
             m, k, counts = jax.lax.map(body, (stacked, met_s, waves))
-            return m, k, counts
+            return m, k, counts                      # counts [G, n, 6]
 
         return run
 
-    step_full = one_cycle(not noswap, not nomove, not noinsert)
-    step_light = step_full if noswap else one_cycle(
-        False, not nomove, not noinsert)
-
+    steps: dict = {}
+    block = default_cycle_block(stacked.vert)
     c = 0
     regrows = 0
     while c < cycles:
-        step = step_full if (c % 3 == 2 or c >= cycles - 2) else step_light
-        stacked, met_s, counts = step(stacked, met_s,
-                                      jnp.asarray(c, jnp.int32))
-        cs = np.asarray(counts)                   # [G, 6]
-        tot = cs.sum(axis=0)
-        if stats is not None:
-            stats.nsplit += int(tot[0])
-            stats.ncollapse += int(tot[1])
-            stats.nswap += int(tot[2])
-            stats.nmoved += int(tot[3])
-            stats.cycles += 1
-        if verbose >= 3:
-            print(f"  grp cycle {c}: split {tot[0]} collapse {tot[1]} "
-                  f"swap {tot[2]} move {tot[3]} over {ngroups} groups")
-        if int(tot[4]) != 0:
+        nblk = min(block, cycles - c)
+        flags = tuple((cc % 3 == 2 or cc >= cycles - 2) and not noswap
+                      for cc in range(c, c + nblk))
+        if flags not in steps:
+            steps[flags] = one_block(flags)
+        stacked, met_s, counts = steps[flags](stacked, met_s,
+                                              jnp.asarray(c, jnp.int32))
+        cs = np.asarray(counts).sum(axis=0)       # [n, 6] over groups
+        for i in range(nblk):
+            tot = cs[i]
+            if stats is not None:
+                stats.nsplit += int(tot[0])
+                stats.ncollapse += int(tot[1])
+                stats.nswap += int(tot[2])
+                stats.nmoved += int(tot[3])
+                stats.cycles += 1
+            if verbose >= 3:
+                print(f"  grp cycle {c + i}: split {tot[0]} collapse "
+                      f"{tot[1]} swap {tot[2]} move {tot[3]} over "
+                      f"{ngroups} groups")
+        if int(cs[:, 4].max()) != 0:
             if regrows >= 6:
                 raise MemoryError("group capacity exhausted")
             capP = stacked.vert.shape[1]
@@ -112,10 +123,11 @@ def grouped_adapt_pass(mesh: Mesh, met, ngroups: int, cycles: int = 12,
             stacked, met_s = grow_shards(stacked, met_s, 2 * capP,
                                          2 * capT)
             regrows += 1
-            continue
-        c += 1
-        if step is step_full and tot[0] == 0 and tot[1] == 0 \
-                and tot[2] == 0:
+            continue        # re-run the block: truncated winners rerun
+        c += nblk
+        if any((flags[i] or noswap) and
+               int(cs[i][0]) + int(cs[i][1]) + int(cs[i][2]) == 0
+               for i in range(nblk)):
             break
     return merge_shards(stacked, met_s, return_part=True)
 

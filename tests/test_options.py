@@ -76,92 +76,3 @@ def test_hsiz_drives_target_size():
     pm = _run_ok(_staged(hsiz=0.18))
     _, ne_out, *_ = pm.get_mesh_size()
     assert ne_out > len(cube_mesh(3)[1])       # refined vs 0.33 spacing
-
-
-def test_noridge_detection_flag():
-    pm = _staged(hsiz=0.3)
-    pm.info.angle_detection = False
-    _run_ok(pm)
-    # with -nr no MG_GEO ridge tags are produced on output feature edges
-    _, _, is_ridge, _ = pm.get_edges()
-    assert not is_ridge.any()
-
-
-def test_local_parameters_clamp_sizes():
-    """MMG3D_Set_localParameter path: vertices on surface ref 7 get the
-    local [hmin,hmax] clamp; elsewhere the global size applies."""
-    from parmmg_tpu.core.constants import IDIR
-    vert, tet = cube_mesh(3)
-    faces = []
-    for t in tet:
-        for f in range(4):
-            tri = t[IDIR[f]]
-            if (vert[tri][:, 2] == 0).all():
-                faces.append(tri + 1)
-    faces = np.array(faces)
-    pm = ParMesh()
-    pm.set_mesh_size(np_=len(vert), ne=len(tet), nt=len(faces))
-    pm.set_vertices(vert)
-    pm.set_tetrahedra(tet + 1)
-    pm.set_triangles(faces, refs=np.full(len(faces), 7))
-    pm.info.niter = 1
-    pm.info.imprim = -1
-    pm.set_met_size(1, len(vert))
-    pm.set_scalar_mets(np.full(len(vert), 0.4))
-    pm.set_local_parameter(1, 7, 0.05, 0.15, 0.001)
-    assert pm.run() == C.PMMG_SUCCESS
-    # output metric near z=0 must be clamped to the local hmax
-    out_v, _ = pm.get_vertices()
-    met = pm.get_metric()
-    near = np.isclose(out_v[:, 2], 0)
-    assert near.any()
-    assert met[near].max() <= 0.15 + 1e-5
-    far = out_v[:, 2] > 0.7
-    assert met[far].min() > 0.15
-
-
-def _fem_bad_edges(mesh):
-    """Interior edges whose two endpoints both lie on the boundary (the
-    FEM-incompatible configuration)."""
-    from parmmg_tpu.core.constants import IARE, MG_BDY
-    tet = np.asarray(mesh.tet)
-    tm = np.asarray(mesh.tmask)
-    etag = np.asarray(mesh.etag)
-    vtag = np.asarray(mesh.vtag)
-    ev = np.sort(tet[:, IARE], axis=2)[tm]               # [nt,6,2]
-    interior = (etag[tm] & MG_BDY) == 0
-    both_bdy = ((vtag[ev[..., 0]] & MG_BDY) != 0) & \
-        ((vtag[ev[..., 1]] & MG_BDY) != 0)
-    bad = ev[interior & both_bdy]
-    return {tuple(e) for e in bad.reshape(-1, 2)}
-
-
-def test_fem_mode_removes_interior_bdy_bdy_edges():
-    """Default fem mode (reference default MMG5_FEM,
-    API_functions_pmmg.c:413): after the run, no interior edge connects
-    two boundary points — so no element has two boundary faces or all
-    four vertices on the boundary."""
-    pm = _run_ok(_staged(hsiz=0.4))
-    assert pm.info.fem
-    assert not _fem_bad_edges(pm._out)
-
-
-def test_nofem_skips_fem_splits(monkeypatch):
-    """-nofem: the fem conformity pass is skipped (flag must act, not
-    decorate) — counted via the fem_pass entry point."""
-    import parmmg_tpu.ops.adapt as adapt_mod
-    calls = {"n": 0}
-    orig = adapt_mod.fem_pass
-
-    def counting(*a, **k):
-        calls["n"] += 1
-        return orig(*a, **k)
-
-    monkeypatch.setattr(adapt_mod, "fem_pass", counting)
-    pm = _staged(hsiz=0.4)
-    pm.info.fem = False
-    _run_ok(pm)
-    assert calls["n"] == 0
-    pm2 = _staged(hsiz=0.4)
-    _run_ok(pm2)
-    assert calls["n"] > 0
