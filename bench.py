@@ -90,7 +90,7 @@ def main() -> None:
 
     n = int(os.environ.get("BENCH_N", "16"))          # 6*n^3 tets
     cycles = int(os.environ.get("BENCH_CYCLES", "9"))
-    block = int(os.environ.get("BENCH_BLOCK", "3"))   # fused cycles/dispatch
+    block = int(os.environ.get("BENCH_BLOCK", "9"))   # fused cycles/dispatch
     bdiv = int(os.environ.get("BENCH_BUDGET_DIV", "8"))  # wave top-K div
 
     vert, tet = cube_mesh(n)

@@ -484,7 +484,19 @@ class ParMesh:
 
     def _apply_user_triangles(self, mesh):
         """Match user boundary triangles to tet faces; transfer refs and
-        required tags (what Mmg does from the Triangles field)."""
+        required tags (what Mmg does from the Triangles field).
+
+        With ``info.opnbdy`` (the reference's -opnbdy,
+        libparmmg_tools.c usage + the OpnBdy_peninsula/island CI cases,
+        cmake/testing/pmmg_tests.cmake:153-165): a user triangle that
+        matches an INTERIOR face pair is ingested as an *open boundary*
+        surface — BOTH face slots get MG_BDY | MG_OPNBDY (+ ref / REQ),
+        so the hanging sheet behaves as a boundary for every wave
+        (analysis treats it one-sided, ops.analysis.analyze_mesh).
+        Without the flag interior triangles keep the previous behavior
+        (refs transferred, no boundary promotion) — the reference
+        ignores them unless -opnbdy is given.
+        """
         import jax.numpy as jnp
         from ..core.mesh import tet_face_vertices
 
@@ -492,25 +504,38 @@ class ParMesh:
         capT = mesh.capT
         keys = fv.reshape(capT * 4, 3)
         tria = np.sort(self.tria - 1, axis=1)
-        # dict-free matching: concatenate + lexsort
+        # dict-free matching: concatenate + lexsort; a key segment holds
+        # 1 or 2 face-slot rows (hull / interior pair) + the tria row
         allk = np.concatenate([keys, tria])
         tag = np.concatenate([np.full(capT * 4, -1),
                               np.arange(len(tria))])
         o = np.lexsort(allk.T[::-1])
         ks, ts = allk[o], tag[o]
-        same = (ks[1:] == ks[:-1]).all(axis=1)
+        n = len(ks)
+        same_next = np.concatenate(
+            [(ks[1:] == ks[:-1]).all(axis=1), [False]])
+        head = np.concatenate([[True], ~same_next[:-1]])
+        seg = np.cumsum(head) - 1
+        nseg = seg[-1] + 1 if n else 0
+        is_face = ts < 0
+        is_tria = ~is_face
+        # per segment: the tria id (if any) and the face rows
+        tria_of = np.full(nseg, -1, np.int64)
+        np.maximum.at(tria_of, seg[is_tria], ts[is_tria])
+        nfaces = np.bincount(seg[is_face], minlength=nseg)
         ftag = np.array(np.asarray(mesh.ftag), copy=True).reshape(-1)
         fref = np.array(np.asarray(mesh.fref), copy=True).reshape(-1)
-        slot = np.where(ts < 0, o, -1)   # position in keys if a face row
-        for a, b in [(np.arange(len(same)), np.arange(1, len(same) + 1)),
-                     (np.arange(1, len(same) + 1), np.arange(len(same)))]:
-            pair = same & (ts[a] < 0) & (ts[b] >= 0) \
-                if len(same) else np.zeros(0, bool)
-            faces = slot[a][pair]
-            tids = ts[b][pair]
-            fref[faces] = self.triaref[tids]
-            ftag[faces] |= np.where(self.tria_req[tids],
-                                    np.uint32(C.MG_REQ), np.uint32(0))
+        face_rows = np.where(is_face)[0]
+        fseg = seg[face_rows]
+        hit = tria_of[fseg] >= 0
+        tids = tria_of[fseg][hit]
+        slots = o[face_rows[hit]]
+        fref[slots] = self.triaref[tids]
+        ftag[slots] |= np.where(self.tria_req[tids],
+                                np.uint32(C.MG_REQ), np.uint32(0))
+        if self.info.opnbdy:
+            interior = nfaces[fseg][hit] == 2
+            ftag[slots[interior]] |= np.uint32(C.MG_BDY | C.MG_OPNBDY)
         return dataclasses.replace(
             mesh, ftag=jnp.asarray(ftag.reshape(capT, 4)),
             fref=jnp.asarray(fref.reshape(capT, 4)))

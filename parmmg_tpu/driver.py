@@ -255,77 +255,54 @@ def parmmg_run(pm) -> tuple[Mesh, object, AdaptStats]:
                 break
             stats += st
     else:
-        from .parallel.dist import (distributed_adapt,
-                                    distributed_adapt_multi,
+        from .parallel.dist import (distributed_adapt_multi,
                                     ShardOverflowError)
-        from .parallel.partition import move_interfaces
         part = None
         niter = max(1, info.niter)
         vrb = 3 if info.imprim >= C.PMMG_VERB_ITWAVES else 0
-        if info.repartitioning == C.REPART_IFC_DISPLACEMENT:
-            # default mode: shard-RESIDENT outer loop — one split, then
-            # niter adapt passes with incremental interface-band
-            # migration between them (advancing-front flood on device +
-            # O(band) host orchestration, parallel/migrate.py), one merge
-            # at the end.  No whole-mesh merge happens between outer
-            # iterations — the reference's migrate-only-moving-groups
-            # design (loadbalancing_pmmg.c + distributegrps_pmmg.c)
-            # distributed input stays distributed: adopt the caller's
-            # partition when it matches the device count (the reference
-            # preserves the input decomposition and only rebuilds comms,
-            # libparmmg.c:206-329); the dedup at load time kept tet order
-            in_part = getattr(pm, "_in_part", None)
-            n_t0 = int(np.asarray(mesh.tmask).sum())
-            # the shard COUNT must equal the device count: fewer shards
-            # would leave devices permanently empty (the flood never
-            # populates a shard that shares no interface)
-            if in_part is not None and (
-                    len(in_part) != n_t0
-                    or int(in_part.max()) + 1 != info.n_devices):
-                in_part = None
-            try:
-                with tim("adaptation"):
-                    mesh, met, part = distributed_adapt_multi(
-                        mesh, met, info.n_devices, niter=niter,
-                        verbose=vrb, stats=stats,
-                        noinsert=info.noinsert, noswap=info.noswap,
-                        nomove=info.nomove, angedg=angedg, hausd=hausd,
-                        ifc_layers=info.ifc_layers,
-                        nobalancing=info.nobalancing, part=in_part)
-            except ShardOverflowError as e:
-                mesh, met, part = e.mesh, e.met, e.part
-                stats.status = C.PMMG_LOWFAILURE
-                if info.imprim >= 0:
-                    import sys
-                    print("  ## Warning: shard capacity exhausted; "
-                          "saving the last conforming mesh "
-                          "(LOWFAILURE).", file=sys.stderr)
-        else:
-            # graph-balancing mode: the reference gathers the group graph
-            # on rank 0 and re-partitions globally (metis_pmmg.c:1343) —
-            # the merge-repartition-resplit round trip is inherent here
-            for it in range(niter):
-                try:
-                    with tim("adaptation"):
-                        mesh, met, part = distributed_adapt(
-                            mesh, met, info.n_devices, part=part,
-                            verbose=vrb,
-                            stats=stats, noinsert=info.noinsert,
-                            noswap=info.noswap, nomove=info.nomove,
-                            angedg=angedg, hausd=hausd)
-                except ShardOverflowError as e:
-                    # degrade to LOWFAILURE with the conforming merged
-                    # state (failed_handling, libparmmg1.c:974-1011)
-                    mesh, met, part = e.mesh, e.met, e.part
-                    stats.status = C.PMMG_LOWFAILURE
-                    if info.imprim >= 0:
-                        import sys
-                        print("  ## Warning: shard capacity exhausted; "
-                              "saving the last conforming mesh "
-                              "(LOWFAILURE).", file=sys.stderr)
-                    break
-                if it + 1 < niter:
-                    part = None      # fresh graph partition next iter
+        # Both repartitioning modes run the shard-RESIDENT outer loop —
+        # one split, niter adapt passes, ONE merge at final output
+        # (the reference's migrate-only-moving-groups design,
+        # loadbalancing_pmmg.c + distributegrps_pmmg.c).  The modes
+        # differ only in the between-iteration labels: advancing-front
+        # interface displacement (default, device flood) vs group-graph
+        # repartitioning (morton clusters + weighted KL/FM — the
+        # metis_pmmg.c:845-1550 gather-only-the-graph role).
+        mode = "ifc" if info.repartitioning == C.REPART_IFC_DISPLACEMENT \
+            else "graph"
+        # distributed input stays distributed: adopt the caller's
+        # partition when it matches the device count (the reference
+        # preserves the input decomposition and only rebuilds comms,
+        # libparmmg.c:206-329); the dedup at load time kept tet order
+        in_part = getattr(pm, "_in_part", None)
+        n_t0 = int(np.asarray(mesh.tmask).sum())
+        # the shard COUNT must equal the device count: fewer shards
+        # would leave devices permanently empty (the flood never
+        # populates a shard that shares no interface)
+        if in_part is not None and (
+                len(in_part) != n_t0
+                or int(in_part.max()) + 1 != info.n_devices):
+            in_part = None
+        try:
+            with tim("adaptation"):
+                mesh, met, part = distributed_adapt_multi(
+                    mesh, met, info.n_devices, niter=niter,
+                    verbose=vrb, stats=stats,
+                    noinsert=info.noinsert, noswap=info.noswap,
+                    nomove=info.nomove, angedg=angedg, hausd=hausd,
+                    ifc_layers=info.ifc_layers,
+                    nobalancing=info.nobalancing, part=in_part,
+                    mode=mode)
+        except ShardOverflowError as e:
+            # degrade to LOWFAILURE with the conforming merged state
+            # (failed_handling, libparmmg1.c:974-1011)
+            mesh, met, part = e.mesh, e.met, e.part
+            stats.status = C.PMMG_LOWFAILURE
+            if info.imprim >= 0:
+                import sys
+                print("  ## Warning: shard capacity exhausted; "
+                      "saving the last conforming mesh "
+                      "(LOWFAILURE).", file=sys.stderr)
         # bad-element optimization on the merged mesh (same contract as
         # the single-device path: sliver_polish after the sizing loop)
         if not (info.noinsert and info.noswap and info.nomove):

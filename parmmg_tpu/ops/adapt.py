@@ -91,15 +91,22 @@ def adapt_cycle_impl(mesh: Mesh, met: jax.Array, wave: jax.Array,
         from .edges import unique_edges, edge_lengths
         et0 = unique_edges(mesh)
         lens0 = edge_lengths(mesh, et0, met)
+        # ridge tangents once per cycle too (same sharing rationale;
+        # collapse only consults non-stale candidates, whose tangent
+        # fields are identical pre/post split)
+        vtan0 = None
+        if hausd is not None:
+            from .analysis import ridge_vertex_tangents
+            vtan0 = ridge_vertex_tangents(mesh, et=et0)
         res = split_wave(mesh, met, hausd=hausd, budget_div=budget_div,
-                         et=et0, lens=lens0)
+                         et=et0, lens=lens0, vtan=vtan0)
         mesh, met = res.mesh, res.met
         nsplit, overflow = res.nsplit, res.overflow
 
         col = collapse_wave(mesh, met, hausd=hausd,
                             budget_div=budget_div,
                             et=et0, lens=lens0,
-                            stale_tets=res.modified)
+                            stale_tets=res.modified, vtan=vtan0)
         # collapse rewires the surface (dying tets' face tags transfer to
         # the surviving neighbors); re-propagate MG_BDY from faces to
         # their edges and vertices so later splits/smooth treat the new
@@ -210,11 +217,13 @@ adapt_cycles_fused = partial(jax.jit, static_argnames=(
 
 
 def default_cycle_block(x=None) -> int:
-    """Fused cycles per dispatch for the production drivers: 3 on TPU
-    (each dispatch pays a ~70-110 ms tunnel round trip — the bench's
-    measured amortization), 1 elsewhere (a local backend gains nothing
-    and the CPU test matrix would pay 3x the compile time).  Override
-    with PARMMG_CYCLE_BLOCK."""
+    """Fused cycles per dispatch for the production drivers: 9 on TPU
+    (each dispatch pays a ~70-110 ms tunnel round trip; measured 0.222
+    -> 0.236 Mtets/s going 3 -> 9 on the bench workload), 1 elsewhere
+    (a local backend gains nothing and the CPU test matrix would pay
+    the multiplied compile time).  Convergence overshoot inside a block
+    is bounded by the zero-candidate lax.cond skips.  Override with
+    PARMMG_CYCLE_BLOCK."""
     import os
     v = os.environ.get("PARMMG_CYCLE_BLOCK", "")
     if v:
@@ -227,7 +236,7 @@ def default_cycle_block(x=None) -> int:
         plat = None
     if plat is None:
         plat = jax.default_backend()
-    return 3 if plat == "tpu" else 1
+    return 9 if plat == "tpu" else 1
 
 
 def sliver_polish_impl(mesh: Mesh, met: jax.Array, wave: jax.Array,
@@ -308,7 +317,7 @@ def adapt_mesh(mesh: Mesh, met: jax.Array, max_cycles: int = 50,
     once the mesh is near convergence.
 
     Cycles are dispatched in fused blocks of ``cycle_block`` (default:
-    3 on TPU, 1 elsewhere — see default_cycle_block): on the tunneled
+    9 on TPU, 1 elsewhere — see default_cycle_block): on the tunneled
     chip every dispatch pays a transport round trip and a counter pull,
     so the production driver pays one per BLOCK, exactly like bench.py.
 

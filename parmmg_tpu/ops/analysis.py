@@ -73,6 +73,54 @@ def boundary_vertex_normals(mesh: Mesh) -> jax.Array:
     return nacc / (jnp.linalg.norm(nacc, axis=-1, keepdims=True) + EPSD)
 
 
+def ridge_vertex_tangents(mesh: Mesh, et=None) -> jax.Array:
+    """[capP, 3] unit tangent of the feature (ridge/ref) line at each
+    MG_GEO/MG_REF vertex; zeros elsewhere.
+
+    The reference stores the tangent in the xPoint alongside the two
+    per-side normals (Mmg norver; maintained across ranks by
+    PMMG_hashNorver, analys_pmmg.c:199-1171).  Batched equivalent: the
+    direction sign along a curve is arbitrary, so accumulate the OUTER
+    PRODUCT of the incident special-edge directions per vertex (sign-
+    free) and take the principal eigenvector by a few power iterations —
+    exact for <=2 incident feature edges (the ridge-point case).
+    """
+    from ..core.constants import MG_GEO, MG_REF
+    capP = mesh.capP
+    if et is None:      # callers on the hot path pass their shared table
+        et = unique_edges(mesh)
+    special = et.emask & ((et.etag & (MG_GEO | MG_REF)) != 0)
+    va = jnp.clip(et.ev[:, 0], 0, capP - 1)
+    vb = jnp.clip(et.ev[:, 1], 0, capP - 1)
+    d = mesh.vert[vb] - mesh.vert[va]
+    d = d / jnp.maximum(jnp.linalg.norm(d, axis=-1, keepdims=True),
+                        1e-30)
+    outer = d[:, :, None] * d[:, None, :]                 # [E,3,3]
+    pay = jnp.where(special[:, None, None], outer, 0.0).reshape(-1, 9)
+    idx2 = jnp.concatenate([jnp.where(special, va, capP),
+                            jnp.where(special, vb, capP)])
+    M = jnp.zeros((capP + 1, 9), mesh.vert.dtype).at[idx2].add(
+        jnp.concatenate([pay, pay]), mode="drop")[:capP].reshape(
+        capP, 3, 3)
+    has = jnp.trace(M, axis1=1, axis2=2) > 1e-12
+    # principal eigenvector by power iteration (M is PSD; 4 steps are
+    # plenty for the 2-edge spectrum).  Init with the column under the
+    # largest diagonal entry — never orthogonal to the principal
+    # direction (a fixed init like (1,1,1) is exactly orthogonal to
+    # common lattice directions such as (1,-1,0)).
+    diag = M[:, jnp.arange(3), jnp.arange(3)]
+    jcol = jnp.argmax(diag, axis=1)
+    v = jnp.take_along_axis(M, jcol[:, None, None].repeat(3, 1),
+                            axis=2)[:, :, 0]
+    v = jnp.where(jnp.linalg.norm(v, axis=-1, keepdims=True) > 1e-30,
+                  v, 1.0)
+    for _ in range(4):
+        v = jnp.einsum("pij,pj->pi", M, v)
+        v = v / jnp.maximum(jnp.linalg.norm(v, axis=-1, keepdims=True),
+                            1e-30)
+    return jnp.where(has[:, None], v, 0.0)
+
+
 def face_normals(mesh: Mesh) -> jax.Array:
     """[capT, 4, 3] outward (non-unit) normals of each tet face.
 
@@ -95,7 +143,17 @@ def analyze_mesh_impl(mesh: Mesh, angedg: float = ANGEDG) -> AnalysisResult:
     et = unique_edges(mesh)
     capE = et.ev.shape[0]
 
-    is_bdy_face = ((mesh.ftag & MG_BDY) != 0) & mesh.tmask[:, None]  # [T,4]
+    # open-boundary faces (-opnbdy ingestion, MG_OPNBDY): an interior
+    # face pair carries the tag on BOTH slots; analysis must see the
+    # sheet ONE-sided (else every sheet edge counts 4 records and the
+    # whole sheet turns non-manifold) — the lower-tet-id slot represents
+    # the geometric face
+    from ..core.constants import MG_OPNBDY
+    opn = (mesh.ftag & MG_OPNBDY) != 0
+    own_side = (mesh.adja < 0) | \
+        (jnp.arange(capT)[:, None] < (mesh.adja >> 2))
+    is_bdy_face = ((mesh.ftag & MG_BDY) != 0) & mesh.tmask[:, None] & \
+        (~opn | own_side)                                             # [T,4]
     nrm = face_normals(mesh)                                          # [T,4,3]
     nrm_unit = nrm / jnp.maximum(
         jnp.linalg.norm(nrm, axis=-1, keepdims=True), 1e-30)
@@ -114,6 +172,7 @@ def analyze_mesh_impl(mesh: Mesh, angedg: float = ANGEDG) -> AnalysisResult:
                              (capT, 4, 3, 3)).reshape(R, 3)
     fref_f = jnp.broadcast_to(mesh.fref[:, :, None],
                               (capT, 4, 3)).reshape(R)
+    opn_f = jnp.broadcast_to(opn[:, :, None], (capT, 4, 3)).reshape(R)
 
     # --- sort records by eid, match neighbors in segments ----------------
     key = jnp.where(val_f, eid_f, capE)
@@ -129,8 +188,12 @@ def analyze_mesh_impl(mesh: Mesh, angedg: float = ANGEDG) -> AnalysisResult:
     partner = jnp.where(same_next, idx + 1,
                         jnp.where(same_prev, idx - 1, idx))
     # per-record pair tests (meaningful only when the segment has size 2;
-    # larger segments are non-manifold and flagged by the count below)
+    # larger segments are non-manifold and flagged by the count below).
+    # Open-boundary sheets are unoriented (the representative slot's
+    # normal sign is arbitrary): their dihedral test uses |dot|.
+    o_s = opn_f[order]
     dot = jnp.sum(n_s * n_s[partner], axis=-1)
+    dot = jnp.where(o_s | o_s[partner], jnp.abs(dot), dot)
     ridge_r = v_s & (same_next | same_prev) & (dot < angedg)
     refed_r = v_s & (same_next | same_prev) & (r_s != r_s[partner])
 

@@ -67,7 +67,8 @@ def collapse_wave(mesh: Mesh, met: jax.Array, lmin: float = LSHRT,
                   hausd: float | None = None,
                   budget_div: int = 8,
                   et=None, lens=None,
-                  stale_tets: jax.Array | None = None) -> CollapseResult:
+                  stale_tets: jax.Array | None = None,
+                  vtan: jax.Array | None = None) -> CollapseResult:
     """One independent-set collapse wave.
 
     Normal mode: contract edges shorter than ``lmin`` (Mmg's colver over
@@ -127,7 +128,8 @@ def collapse_wave(mesh: Mesh, met: jax.Array, lmin: float = LSHRT,
         # the top-K cut: a post-cut veto would let permanently-vetoed
         # boundary edges pin budget slots every wave, starving legal
         # candidates ranked past K
-        from .analysis import boundary_vertex_normals
+        from .analysis import boundary_vertex_normals, \
+            ridge_vertex_tangents
         vn = boundary_vertex_normals(mesh)
         on_bdy_f = (et.etag & MG_BDY) != 0
         d_f = mesh.vert[vb_f] - mesh.vert[va_f]
@@ -135,6 +137,16 @@ def collapse_wave(mesh: Mesh, met: jax.Array, lmin: float = LSHRT,
         t_a = d_f - na_f * jnp.sum(na_f * d_f, -1, keepdims=True)
         t_b = d_f - nb_f * jnp.sum(nb_f * d_f, -1, keepdims=True)
         dev = jnp.linalg.norm(0.125 * (t_a - t_b), axis=-1)
+        # feature-line edges: curvature deviation along the LINE
+        # tangent, not the (multivalued) surface normal — matches the
+        # tangent-circle lift in split_wave
+        tanv = vtan if vtan is not None \
+            else ridge_vertex_tangents(mesh, et=et)
+        on_line_f = (et.etag & (MG_GEO | MG_REF)) != 0
+        ta_l = tanv[va_f] * jnp.sum(tanv[va_f] * d_f, -1, keepdims=True)
+        tb_l = tanv[vb_f] * jnp.sum(tanv[vb_f] * d_f, -1, keepdims=True)
+        dev_l = jnp.linalg.norm(0.125 * (ta_l - tb_l), axis=-1)
+        dev = jnp.where(on_line_f, dev_l, dev)
         pre = pre & ~(on_bdy_f & (dev > hausd))
 
     # Everything below (top-K sort, role derivation, tet-centric

@@ -59,7 +59,8 @@ def split_wave(mesh: Mesh, met: jax.Array, lmax: float = LLONG,
                budget_div: int = 8,
                fem_only: bool = False,
                et: EdgeTable | None = None,
-               lens: jax.Array | None = None) -> SplitResult:
+               lens: jax.Array | None = None,
+               vtan: jax.Array | None = None) -> SplitResult:
     """One independent-set split wave. Jittable; static shapes throughout.
 
     ``hausd`` enables the PLACEMENT half of surface-approximation
@@ -111,7 +112,8 @@ def split_wave(mesh: Mesh, met: jax.Array, lmax: float = LLONG,
         cand = et.emask & (lens > lmax) & ~frozen_edge
     lift_corr = None
     if hausd is not None:
-        from .analysis import boundary_vertex_normals
+        from .analysis import boundary_vertex_normals, \
+            ridge_vertex_tangents
         from ..core.constants import MG_CRN, MG_NOM
         vn = boundary_vertex_normals(mesh)
         sing = MG_GEO | MG_CRN | MG_REQ | MG_PARBDY | MG_NOM | MG_REF
@@ -127,6 +129,25 @@ def split_wave(mesh: Mesh, met: jax.Array, lmax: float = LLONG,
         # folds sqrt(8*hausd/kappa) into boundary sizes, the Mmg defsiz
         # route); here hausd only drives point PLACEMENT
         lift_corr = jnp.where(regular[:, None], corr, 0.0)
+        # curved FEATURE LINES (ridge/ref edges between two plain
+        # ridge/ref points): lift the midpoint along the tangent circle
+        # of the feature curve — the Hermite analogue of the surface
+        # lift with the edge vector projected on each endpoint's LINE
+        # tangent (the reference keeps per-point tangents in the xPoint
+        # and maintains them across ranks, analys_pmmg.c:199-1171).
+        # Without this, curved ridges (torus equator class) stay
+        # piecewise-linear no matter how fine the metric.
+        tan = vtan if vtan is not None \
+            else ridge_vertex_tangents(mesh, et=et)
+        hard = MG_CRN | MG_REQ | MG_PARBDY | MG_NOM
+        on_line = ((et.etag & (MG_GEO | MG_REF)) != 0) & \
+            ((et.etag & (MG_REQ | MG_PARBDY)) == 0) & \
+            ((mesh.vtag[va] & hard) == 0) & \
+            ((mesh.vtag[vb] & hard) == 0)
+        ta_l = tan[va] * jnp.sum(tan[va] * d, -1, keepdims=True)
+        tb_l = tan[vb] * jnp.sum(tan[vb] * d, -1, keepdims=True)
+        corr_l = 0.125 * (ta_l - tb_l)
+        lift_corr = jnp.where(on_line[:, None], corr_l, lift_corr)
     # Everything below (nomination, degeneracy veto, winner
     # selection, apply) is lax.cond-skipped when NO candidate edge
     # exists — at convergence the wave then costs only the table +

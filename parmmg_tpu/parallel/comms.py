@@ -64,7 +64,126 @@ def build_interface_comms(tet: np.ndarray, part: np.ndarray,
     no common face (the completeExtNodeComm case :1826): node comms here
     are derived from the full vertex->shards incidence, which covers
     vertex-only adjacency by construction.
+
+    Fully sort/segment based: no [nvert, nparts] dense incidence and no
+    per-item Python loops, so construction stays O(interface log) at
+    S=64 and beyond.  Item ordering is bit-identical to the reference
+    implementation below (faces by global key, nodes by global id — the
+    A.4 ordering contract); tests/test_comms.py asserts the equality.
     """
+    n = len(tet)
+    S = nparts
+    # ---- interface faces (matched pairs across parts) -------------------
+    faces = np.sort(tet[:, IDIR].reshape(n * 4, 3), axis=1)
+    key = (faces[:, 0].astype(np.int64) << 42) | \
+          (faces[:, 1].astype(np.int64) << 21) | faces[:, 2].astype(np.int64)
+    order = np.argsort(key, kind="stable")
+    ks = key[order]
+    same = ks[1:] == ks[:-1]
+    fA, fB = order[:-1][same], order[1:][same]
+    pA, pB = part[fA // 4], part[fB // 4]
+    cross = pA != pB
+    fA, fB, pA, pB = fA[cross], fB[cross], pA[cross], pB[cross]
+    fkey = key[fA]
+
+    # group matched faces by unordered pair, keep fkey order inside each
+    lo = np.minimum(pA, pB).astype(np.int64)
+    hi = np.maximum(pA, pB).astype(np.int64)
+    o2 = np.lexsort((fkey, hi, lo))
+    loS, hiS = lo[o2], hi[o2]
+    fA_s, fB_s, pA_s = fA[o2], fB[o2], pA[o2]
+    head = np.concatenate([[True], (loS[1:] != loS[:-1]) |
+                           (hiS[1:] != hiS[:-1])]) \
+        if len(loS) else np.zeros(0, bool)
+    bounds = np.concatenate([np.where(head)[0], [len(loS)]]) \
+        if len(loS) else np.array([0])
+    face_lists = [[[] for _ in range(S)] for _ in range(S)]
+    for bi in range(len(bounds) - 1):
+        sl = slice(bounds[bi], bounds[bi + 1])
+        a, b = int(loS[bounds[bi]]), int(hiS[bounds[bi]])
+        a_is_A = pA_s[sl] == a
+        fa = np.where(a_is_A, fA_s[sl], fB_s[sl])
+        fb = np.where(a_is_A, fB_s[sl], fA_s[sl])
+        face_lists[a][b] = fa.tolist()
+        face_lists[b][a] = fb.tolist()
+
+    # ---- vertex -> parts incidence (sorted pairs, no dense matrix) ------
+    allg = np.concatenate([np.asarray(l, np.int64) for l in l2g]) \
+        if l2g else np.zeros(0, np.int64)
+    allsh = np.concatenate([np.full(len(l), s, np.int64)
+                            for s, l in enumerate(l2g)])
+    ov = np.lexsort((allsh, allg))
+    gs, ss = allg[ov], allsh[ov]
+    headv = np.concatenate([[True], gs[1:] != gs[:-1]]) \
+        if len(gs) else np.zeros(0, bool)
+    segv = np.cumsum(headv) - 1
+    nseg = int(segv[-1]) + 1 if len(segv) else 0
+    cnt = np.bincount(segv, minlength=nseg)
+    # owner per global id = max incident shard (sorted segments: last)
+    last = np.concatenate([headv[1:], [True]]) if len(gs) else headv
+    owner_of_seg = ss[last] if len(gs) else np.zeros(0, np.int64)
+    seg_gid = gs[headv] if len(gs) else np.zeros(0, np.int64)
+
+    # pair expansion: each row pairs with every OTHER row of its segment
+    crow = cnt[segv]
+    startv = np.zeros(nseg, np.int64)
+    if nseg:
+        startv[1:] = np.cumsum(cnt)[:-1]
+    rankv = np.arange(len(gs)) - startv[segv]
+    rep = np.repeat(np.arange(len(gs)), np.maximum(crow - 1, 0))
+    m = len(rep)
+    if m:
+        off_in = np.arange(m) - np.repeat(
+            np.concatenate([[0], np.cumsum(np.maximum(crow - 1, 0))[:-1]]),
+            np.maximum(crow - 1, 0))
+        r_rep = rankv[rep]
+        other_rank = off_in + (off_in >= r_rep)
+        other = startv[segv[rep]] + other_rank
+        a_sh = ss[rep]
+        b_sh = ss[other]
+        gid_p = gs[rep]
+    else:
+        a_sh = b_sh = gid_p = np.zeros(0, np.int64)
+    # group by (a, b); gid order inside each pair list is preserved by a
+    # stable sort (segments were gid-ascending already)
+    node_lists = [[[] for _ in range(S)] for _ in range(S)]
+    if m:
+        op = np.lexsort((gid_p, b_sh, a_sh))
+        aS, bS, gS = a_sh[op], b_sh[op], gid_p[op]
+        headp = np.concatenate([[True], (aS[1:] != aS[:-1]) |
+                                (bS[1:] != bS[:-1])])
+        pb = np.concatenate([np.where(headp)[0], [len(aS)]])
+        for bi in range(len(pb) - 1):
+            sl = slice(pb[bi], pb[bi + 1])
+            node_lists[int(aS[pb[bi]])][int(bS[pb[bi]])] = gS[sl].tolist()
+
+    # ---- convert to local indices and pad into tables -------------------
+    node_loc = [[(g2l[s][np.asarray(node_lists[s][b], np.int64)].tolist()
+                  if node_lists[s][b] else [])
+                 for b in range(S)] for s in range(S)]
+    face_loc = [[(_global_face_to_local(
+                    np.asarray(face_lists[s][b], np.int64), part,
+                    s).tolist() if face_lists[s][b] else [])
+                 for b in range(S)] for s in range(S)]
+    owner = []
+    gid2owner = np.full(int(allg.max()) + 1 if len(allg) else 1, -1,
+                        np.int64)
+    if nseg:
+        gid2owner[seg_gid] = owner_of_seg
+    for s in range(S):
+        ow = gid2owner[np.asarray(l2g[s], np.int64)].astype(np.int32) \
+            if len(l2g[s]) else np.zeros(0, np.int32)
+        ow[ow < 0] = s
+        owner.append(ow)
+    return pad_comm_tables(node_loc, face_loc, owner, S)
+
+
+def build_interface_comms_ref(tet: np.ndarray, part: np.ndarray,
+                              nparts: int,
+                              l2g: list[np.ndarray],
+                              g2l: list[np.ndarray]) -> InterfaceComms:
+    """Reference (dense-incidence, per-item Python loop) construction —
+    kept as the bit-identity oracle for the sort-based builder above."""
     n = len(tet)
     # ---- interface faces (matched pairs across parts) -------------------
     faces = np.sort(tet[:, IDIR].reshape(n * 4, 3), axis=1)
@@ -185,30 +304,32 @@ def halo_exchange(vals, send_idx, nbr, axis_name: str = "shard",
     (zeros on pads).  The caller merges with its own gather + owner rule —
     the scatter/merge half of the reference idiom.
 
-    Implementation: one ``all_gather`` of the [K, I] send buffers over the
-    shard axis (ICI), then a static gather: shard s reads from gathered
-    buffer of shard nbr[k] the slot whose nbr points back to s.
+    Implementation: NEIGHBOR exchange via ``all_to_all`` — each shard
+    scatters its per-neighbor buffers into a [S, I] send matrix (row j =
+    items for shard j), the collective transposes it across the axis,
+    and row j of the result is what shard j sent me.  Traffic is
+    O(S * I) per shard instead of the previous all_gather's
+    O(S * K * I) broadcast — the difference between S=8 and S=64
+    viability (VERDICT r2 comm-layer scaling item).
     """
     import jax
     import jax.numpy as jnp
 
     K, I = send_idx.shape
+    S = jax.lax.axis_size(axis_name)
     safe = jnp.clip(send_idx, 0, vals.shape[0] - 1)
     send = jnp.where(
         (send_idx >= 0).reshape(K, I + (vals.ndim - 1) * 0, *([1] *
                                 (vals.ndim - 1))),
         vals[safe], 0) if vals.ndim > 1 else \
         jnp.where(send_idx >= 0, vals[safe], 0)
-    # all shards' (send buffers, nbr tables)
-    all_send = jax.lax.all_gather(send, axis_name)     # [S, K, I, ...]
-    all_nbr = jax.lax.all_gather(nbr, axis_name)       # [S, K]
-    me = jax.lax.axis_index(axis_name)
-
-    # for my neighbor slot k (shard b=nbr[k]): find k' with all_nbr[b,k']==me
-    b = jnp.clip(nbr, 0, all_send.shape[0] - 1)
-    back = all_nbr[b]                                   # [K, K]
-    kprime = jnp.argmax(back == me, axis=1)             # [K]
-    recv = all_send[b, kprime]                          # [K, I, ...]
+    tail = send.shape[2:]
+    mat = jnp.zeros((S, I) + tail, send.dtype)
+    mat = mat.at[jnp.where(nbr >= 0, nbr, S)].set(send, mode="drop",
+                                                  unique_indices=True)
+    recv_mat = jax.lax.all_to_all(mat, axis_name, split_axis=0,
+                                  concat_axis=0, tiled=True)
+    recv = recv_mat[jnp.clip(nbr, 0, S - 1)]            # [K, I, ...]
     valid = (nbr >= 0)
     if vals.ndim > 1:
         valid = valid.reshape(K, *([1] * (recv.ndim - 1)))

@@ -206,6 +206,43 @@ def dist_interface_check(dmesh: DeviceMesh):
     return jax.jit(fn)
 
 
+def refresh_shard_analysis_device(stacked: Mesh, comms, n_shards: int,
+                                  angedg: float, glo, dmesh,
+                                  cache: dict | None = None):
+    """Device-resident analysis refresh (parallel/analysis_dev.py): the
+    sort/segment reductions of the host path run jitted under shard_map,
+    keyed by the persistent global numbering — no O(mesh) host pull.
+
+    Returns the updated stacked mesh, or None when the shared-record
+    budget overflowed (caller falls back to the host path) — never a
+    silent truncation."""
+    import os
+    if os.environ.get("PARMMG_HOST_ANALYSIS", "") == "1":
+        return None
+    from .analysis_dev import dist_analysis
+    glo_np = np.stack([np.asarray(g) for g in glo])
+    if glo_np.max() >= np.iinfo(np.int32).max:
+        return None                      # int32 id budget exhausted
+    capT = stacked.tet.shape[1]
+    KS = int(min(12 * capT,
+                 max(1024, 4 * comms.node_idx[0].size)))
+    key = (angedg, KS, n_shards)
+    if cache is not None and key in cache:
+        fn = cache[key]
+    else:
+        fn = dist_analysis(dmesh, angedg, KS)
+        if cache is not None:
+            cache[key] = fn
+    vt, et, ovf = fn(
+        stacked,
+        shard_stacked(jnp.asarray(glo_np.astype(np.int32)), dmesh),
+        shard_stacked(jnp.asarray(comms.node_idx), dmesh),
+        shard_stacked(jnp.asarray(comms.nbr), dmesh))
+    if int(ovf) != 0:
+        return None
+    return dataclasses.replace(stacked, vtag=vt, etag=et)
+
+
 def refresh_shard_analysis(stacked: Mesh, comms, n_shards: int,
                            angedg: float, glo=None, views=None):
     """Cross-shard surface analysis refresh on ADAPTED shards — the
@@ -347,7 +384,7 @@ def run_adapt_cycles(stacked, met_s, steps: DistSteps, cycles,
     ShardOverflowError carrying the conforming merged state
     (failed_handling, libparmmg1.c:974-1011).
 
-    Cycles dispatch in fused blocks (default_cycle_block: 3 on TPU, 1
+    Cycles dispatch in fused blocks (default_cycle_block: 9 on TPU, 1
     elsewhere) — one transport round trip + one counter pull per block,
     the same amortization bench.py measures.
 
@@ -478,10 +515,20 @@ def distributed_adapt(mesh: Mesh, met, n_shards: int,
     # cross-shard surface analysis refresh (PMMG_update_analys analogue)
     # BEFORE the merge: ridge/corner/ref classification with
     # cross-interface dihedrals, written into the shard tags so the
-    # merged mesh needs no whole-mesh re-analysis
+    # merged mesh needs no whole-mesh re-analysis.  Device-resident path
+    # first (analysis_dev.py); host fallback on budget overflow.
     from ..core.constants import ANGEDG
-    stacked = refresh_shard_analysis(
-        stacked, comms, n_shards, ANGEDG if angedg is None else angedg)
+    from .analysis_par import extend_numbering
+    ang_ = ANGEDG if angedg is None else angedg
+    capP_ = stacked.vert.shape[1]
+    glo_ = extend_numbering(comms, [capP_] * n_shards)
+    st2 = refresh_shard_analysis_device(stacked, comms, n_shards, ang_,
+                                        glo_, dmesh)
+    if st2 is not None:
+        stacked = st2
+    else:
+        stacked = refresh_shard_analysis(stacked, comms, n_shards, ang_,
+                                         glo=glo_)
     merged, met_m, part_new = merge_shards(stacked, met_s,
                                            return_part=True)
     return merged, met_m, part_new
@@ -497,8 +544,18 @@ def distributed_adapt_multi(mesh: Mesh, met, n_shards: int,
                             hausd: float | None = None,
                             ifc_layers: int = 2,
                             nobalancing: bool = False,
-                            part: np.ndarray | None = None):
+                            part: np.ndarray | None = None,
+                            mode: str = "ifc"):
     """Shard-resident multi-iteration adaptation (host driver).
+
+    ``mode``: between-iteration label source — "ifc" = advancing-front
+    interface displacement (device flood, the default repartitioning of
+    the reference, libparmmgtypes.h:194); "graph" = group-graph
+    repartitioning (morton clusters as the reference's redistribution
+    groups, weighted KL/FM on the cluster graph —
+    migrate.graph_repartition_labels, metis_pmmg.c:845-1550 role).
+    Both realize the moves with the SAME band-migration machinery, so
+    neither merges the world between iterations.
 
     The reference's outer loop re-balances by migrating only moving
     groups over the wire (loadbalancing_pmmg.c:44-161 +
@@ -522,9 +579,10 @@ def distributed_adapt_multi(mesh: Mesh, met, n_shards: int,
                             refine_partition)
     from .distribute import split_to_shards, merge_shards
     from .comms import build_interface_comms
-    from .migrate import (pull_views, extend_global_ids, flood_labels,
-                          enforce_ne_min, migrate_shards, rebuild_shards,
-                          weld_shard_bands)
+    from .migrate import (pull_views, extend_global_ids_from_vmask,
+                          flood_labels, enforce_ne_min, migrate_shards,
+                          rebuild_shards, weld_shard_bands,
+                          graph_repartition_labels)
     from .multihost import require_single_process
 
     # the host orchestration below (split, views pull, migration
@@ -580,26 +638,43 @@ def distributed_adapt_multi(mesh: Mesh, met, n_shards: int,
                 [glo[s_], np.full(old_capP, -1, np.int64)])
 
     regrow_state = [0]
+    ana_cache: dict = {}
     for it in range(max(1, niter)):
         stacked, met_s = run_adapt_cycles(
             stacked, met_s, steps, cycles, dmesh,
             stats=stats, verbose=verbose, on_grow=grow_glo,
             regrow_state=regrow_state, label=f"dist it {it}",
             noswap=noswap)
-        # host views: ONE consolidated pull serving analysis + migration
-        views = pull_views(stacked, met_s)
-        top = extend_global_ids(glo, views, top)
-        stacked = refresh_shard_analysis(stacked, comms, n_shards, ang,
-                                         glo=glo, views=views)
+        # extend the session numbering from a vmask-only pull (tiny),
+        # run the DEVICE analysis refresh, THEN pull the consolidated
+        # views — the single big pull carries the refreshed tags, so no
+        # host-numpy analysis and no tag re-push are needed
+        vmask_h = np.asarray(stacked.vmask)
+        top = extend_global_ids_from_vmask(glo, vmask_h, top)
+        st2 = refresh_shard_analysis_device(
+            stacked, comms, n_shards, ang, glo, dmesh, cache=ana_cache)
+        if st2 is not None:
+            stacked = st2
+            views = pull_views(stacked, met_s)
+        else:
+            # host fallback (shared-record budget overflow)
+            views = pull_views(stacked, met_s)
+            stacked = refresh_shard_analysis(
+                stacked, comms, n_shards, ang, glo=glo, views=views)
         if it + 1 < max(1, niter) and not nobalancing:
-            sizes = jnp.asarray(views.tmask.sum(axis=1).astype(np.int32))
-            labels_d, depth_d = flood_labels(
-                stacked, jnp.asarray(comms.node_idx),
-                jnp.asarray(comms.nbr), sizes, n_shards,
-                nlayers=ifc_layers)
-            labels = np.asarray(labels_d)
-            labels = enforce_ne_min(labels, views.tmask, n_shards,
-                                    depth=np.asarray(depth_d))
+            if mode == "graph":
+                labels = graph_repartition_labels(views, glo, n_shards)
+                labels = enforce_ne_min(labels, views.tmask, n_shards)
+            else:
+                sizes = jnp.asarray(
+                    views.tmask.sum(axis=1).astype(np.int32))
+                labels_d, depth_d = flood_labels(
+                    stacked, jnp.asarray(comms.node_idx),
+                    jnp.asarray(comms.nbr), sizes, n_shards,
+                    nlayers=ifc_layers)
+                labels = np.asarray(labels_d)
+                labels = enforce_ne_min(labels, views.tmask, n_shards,
+                                        depth=np.asarray(depth_d))
             # destination shards (band recipients) — computed BEFORE the
             # migration mutates the views/labels shapes
             touched = sorted({int(r) for s_ in range(n_shards)

@@ -203,18 +203,25 @@ def pull_views(stacked: Mesh, met_s) -> ShardViews:
         met=np.array(m), npoin=np.array(h.npoin), nelem=np.array(h.nelem))
 
 
-def extend_global_ids(glo: list[np.ndarray], views: ShardViews, top: int):
+def extend_global_ids_from_vmask(glo: list[np.ndarray],
+                                 vmask: np.ndarray, top: int):
     """Fresh global ids for adapt-created vertices (shard-private by the
-    freeze contract, so a disjoint id block per shard is exact)."""
+    freeze contract, so a disjoint id block per shard is exact).  Takes
+    the [S, capP] validity masks directly so the caller can extend from
+    a vmask-only device pull before the big views pull."""
     for s, g in enumerate(glo):
-        fresh = views.vmask[s] & (g < 0)
+        fresh = vmask[s] & (g < 0)
         n = int(fresh.sum())
         if n:
             g[fresh] = top + np.arange(n, dtype=np.int64)
             top += n
-        dead = ~views.vmask[s]
+        dead = ~vmask[s]
         g[dead] = -1
     return top
+
+
+def extend_global_ids(glo: list[np.ndarray], views: ShardViews, top: int):
+    return extend_global_ids_from_vmask(glo, views.vmask, top)
 
 
 # ---------------------------------------------------------------------------
@@ -772,3 +779,79 @@ def rebuild_shards(stacked: Mesh) -> Mesh:
     from ..ops.adjacency import build_adjacency, boundary_edge_tags
     return jax.vmap(lambda m: boundary_edge_tags(build_adjacency(m)))(
         stacked)
+
+
+# ---------------------------------------------------------------------------
+# group-graph repartitioning labels (graph-balancing mode)
+# ---------------------------------------------------------------------------
+def graph_repartition_labels(views: ShardViews, glo, n_shards: int,
+                             clusters_per_shard: int = 8) -> np.ndarray:
+    """Per-tet target-shard labels from a GROUP-graph repartition — the
+    graph-balancing mode's replacement for merge->METIS->resplit.
+
+    The reference gathers only the group graph (xadj/adjncy/weights) to
+    rank 0 and runs METIS on it (metis_pmmg.c:845-1550) — O(groups)
+    gathered, never the mesh.  Here: each shard's live tets are
+    clustered along the morton curve (the clusters play the reference's
+    'redistribution groups'), the cluster adjacency graph is built from
+    ONE global face sort keyed by the persistent global vertex ids
+    (intra-shard and interface faces in the same pass), and the
+    cluster->shard map is rebalanced with the weighted KL/FM refinement
+    (partition.refine_partition, the METIS-kway role).  The realized
+    moves then ride the band-migration machinery (migrate_shards), so
+    NO whole-mesh merge happens between iterations.
+
+    Returns labels [S, capT] int32 (target shard per live tet).
+    """
+    from ..core.constants import IDIR
+    from .partition import morton_partition, refine_partition
+    S = n_shards
+    capT = views.tet.shape[1]
+    labels = np.tile(np.arange(S, dtype=np.int32)[:, None], (1, capT))
+    cl_local = np.full((S, capT), -1, np.int64)
+    all_tri, all_cl = [], []
+    cweights = []
+    offset = 0
+    for s in range(S):
+        live = np.where(views.tmask[s])[0]
+        if not len(live):
+            cweights.append(np.zeros(clusters_per_shard))
+            offset += clusters_per_shard
+            continue
+        cent = views.vert[s][views.tet[s][live]].mean(axis=1)
+        c = morton_partition(cent, min(clusters_per_shard, len(live)))
+        cl_local[s, live] = c + offset
+        cw = np.bincount(c, minlength=clusters_per_shard).astype(float)
+        cweights.append(cw)
+        gtet = glo[s][views.tet[s][live]]
+        tri = np.sort(gtet[:, IDIR], axis=2).reshape(-1, 3)
+        all_tri.append(tri)
+        all_cl.append(np.repeat(c + offset, 4))
+        offset += clusters_per_shard
+    nclu = offset
+    cw = np.concatenate(cweights)
+    if not all_tri:
+        return labels
+    tri = np.concatenate(all_tri)
+    cl4 = np.concatenate(all_cl)
+    o = np.lexsort((tri[:, 2], tri[:, 1], tri[:, 0]))
+    ts, cs = tri[o], cl4[o]
+    same = np.concatenate([(ts[1:] == ts[:-1]).all(1), [False]])
+    ia = np.where(same)[0]
+    ca, cb = cs[ia], cs[ia + 1]
+    cross = ca != cb
+    pi = np.minimum(ca[cross], cb[cross])
+    pj = np.maximum(ca[cross], cb[cross])
+    # aggregate multiplicity (face count between cluster pairs)
+    key = pi * nclu + pj
+    uk, wcnt = np.unique(key, return_counts=True)
+    pi_u = (uk // nclu).astype(np.int64)
+    pj_u = (uk % nclu).astype(np.int64)
+    init = np.repeat(np.arange(S, dtype=np.int32), clusters_per_shard)
+    new_part = refine_partition(init, S, (pi_u, pj_u),
+                                wcnt.astype(float), elem_w=cw,
+                                npasses=5)
+    for s in range(S):
+        live = cl_local[s] >= 0
+        labels[s][live] = new_part[cl_local[s][live]]
+    return labels

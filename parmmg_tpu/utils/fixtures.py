@@ -121,3 +121,19 @@ def analytic_iso_metric(vert: np.ndarray, kind: str = "uniform",
         d = np.abs(vert[:, 0] - 0.5)
         return h * (0.2 + 4.0 * d)
     raise ValueError(kind)
+
+
+def cylinder_mesh(n: int = 6, r: float = 0.5):
+    """Solid cylinder (radius r, height 1, axis z): cube mesh with the
+    (x, y) square cross-section mapped onto the disk.  The cap rims are
+    CURVED ridge lines (90-degree dihedral along a circle) — the
+    feature-line fixture class (torus-equator/cylinder-cap) the
+    reference CI exercises for ridge geometry."""
+    vert, tet = cube_mesh(n)
+    c = vert[:, :2] * 2.0 - 1.0
+    linf = np.max(np.abs(c), axis=1)
+    l2 = np.linalg.norm(c, axis=1)
+    scale = np.where(l2 > 1e-12, linf / np.maximum(l2, 1e-12), 1.0)
+    vert = np.concatenate([c * scale[:, None] * r, vert[:, 2:]], axis=1)
+    tet = _orient_positive(vert, tet)
+    return vert, tet.astype(np.int32)

@@ -1,0 +1,121 @@
+"""Million-tet single-chip datapoint via the two-level group machinery.
+
+The 10M-tet configuration (BASELINE.md planned configs) is reachable on
+one chip only through sub-device groups: lax.map over group slots keeps
+the working set (and the O(n log^2 n) wave sorts) at GROUP size while
+the stacked state holds the whole mesh (parallel/groups.py, the
+grpsplit_pmmg.c:1551 role).  This script runs one grouped adaptation
+pass on a >=1M-tet shock cube and reports per-phase timings + the
+grouped throughput as ONE JSON line (same shape as bench.py).
+
+Run (real chip): cd /root/repo && python scripts/scale_big.py
+Knobs: SCALE_N (default 56 -> 6*56^3 = 1,053,696 tets),
+       SCALE_TARGET (group size target, default 24576),
+       SCALE_CYCLES (default 6), JAX_PLATFORMS=cpu for a CPU run.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/root/repo/.jax_cache")
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
+
+import numpy as np
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    jax.config.update("jax_compilation_cache_dir",
+                      os.environ["JAX_COMPILATION_CACHE_DIR"])
+
+    from parmmg_tpu.core.mesh import make_mesh
+    from parmmg_tpu.ops.analysis import analyze_mesh
+    from parmmg_tpu.ops.quality import tet_quality
+    from parmmg_tpu.parallel.groups import grouped_adapt_pass, \
+        how_many_groups
+    from parmmg_tpu.parallel.partition import morton_partition
+    from parmmg_tpu.utils.fixtures import cube_mesh, analytic_iso_metric
+    from parmmg_tpu.ops.adapt import AdaptStats
+
+    n = int(os.environ.get("SCALE_N", "56"))
+    target = int(os.environ.get("SCALE_TARGET", "24576"))
+    cycles = int(os.environ.get("SCALE_CYCLES", "6"))
+
+    phases = {}
+    t0 = time.perf_counter()
+    vert, tet = cube_mesh(n)
+    ntet0 = len(tet)
+    phases["host_build"] = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    # host partition: morton only — fix_contiguity's python BFS is an
+    # O(mesh) host stage this datapoint deliberately excludes (group
+    # seams freeze identically either way)
+    cent = vert[tet].mean(axis=1)
+    ngroups = how_many_groups(ntet0, target)
+    part = morton_partition(cent, ngroups)
+    phases["host_partition"] = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    # stage + analyze the FULL mesh on the CPU backend: the whole-mesh
+    # analysis program at 1M-tet width does not compile through the
+    # tunnel in reasonable time (the round-2 BENCH_N=32 blocker) and
+    # runs once — the groups are what the chip executes
+    cpu = jax.devices("cpu")[0]
+    with jax.default_device(cpu):
+        mesh = make_mesh(vert, tet, capP=2 * len(vert),
+                         capT=2 * len(tet))
+        mesh = analyze_mesh(mesh).mesh
+        h = analytic_iso_metric(vert, "shock", h=1.5 / n)
+        met = jnp.zeros(mesh.capP, mesh.vert.dtype).at[: len(h)].set(
+            jnp.asarray(h, mesh.vert.dtype)).at[len(h):].set(1.0)
+        jax.block_until_ready(mesh.vert)
+    phases["stage_analyze"] = time.perf_counter() - t0
+
+    stats = AdaptStats()
+    t0 = time.perf_counter()
+    mesh2, met2, _part2 = grouped_adapt_pass(
+        mesh, met, ngroups, cycles=cycles, part=part, stats=stats,
+        verbose=3 if os.environ.get("SCALE_VERBOSE") else 0)
+    jax.block_until_ready(mesh2.vert)
+    phases["grouped_adapt"] = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    tm = np.asarray(mesh2.tmask)
+    with jax.default_device(cpu):       # full-width program: CPU compile
+        mesh2c = jax.device_put(mesh2, cpu)
+        q = np.asarray(tet_quality(mesh2c, jax.device_put(met2, cpu)))[tm]
+    phases["quality_pull"] = time.perf_counter() - t0
+
+    # throughput accounting mirrors bench.py: live tets examined per
+    # cycle / adapt wall seconds.  The first-pass number INCLUDES the
+    # one-time compile of the group program (reported separately as the
+    # steady rate can't be isolated without a second pass at this size).
+    examined = stats.cycles * ntet0        # lower bound (mesh only grows)
+    rate = examined / max(phases["grouped_adapt"], 1e-9) / 1e6
+    print(json.dumps({
+        "metric": "grouped_scale_throughput",
+        "value": round(rate, 4),
+        "unit": "Mtets/sec/chip (incl. one-time compile)",
+        "extra": {
+            "ntets_initial": int(ntet0),
+            "ntets_final": int(tm.sum()),
+            "ngroups": int(ngroups),
+            "cycles": int(stats.cycles),
+            "ops": [stats.nsplit, stats.ncollapse, stats.nswap,
+                    stats.nmoved],
+            "qmin": round(float(q.min()), 4) if tm.any() else 0.0,
+            "qmean": round(float(q.mean()), 4) if tm.any() else 0.0,
+            "phases_s": {k: round(v, 2) for k, v in phases.items()},
+            "device": str(jax.devices()[0].platform),
+        },
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,120 @@
+"""Device-resident cross-shard analysis vs the host-numpy path.
+
+Contract: parallel/analysis_dev.py must produce exactly the tags the
+host refresh (parallel/dist.refresh_shard_analysis over
+analysis_par.analyze_shards) produces — ridge (MG_GEO), reference
+(MG_REF), corner (MG_CRN) and non-manifold (MG_NOM) classification with
+cross-interface dihedrals, plus the plain-boundary stale-bit clearing.
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from parmmg_tpu.core.mesh import make_mesh, mesh_to_host
+from parmmg_tpu.core import constants as C
+from parmmg_tpu.ops.analysis import analyze_mesh
+from parmmg_tpu.parallel.analysis_par import extend_numbering
+from parmmg_tpu.parallel.comms import build_interface_comms
+from parmmg_tpu.parallel.dist import (
+    make_device_mesh, refresh_shard_analysis,
+    refresh_shard_analysis_device, shard_stacked)
+from parmmg_tpu.parallel.distribute import split_to_shards
+from parmmg_tpu.parallel.partition import morton_partition, fix_contiguity
+from parmmg_tpu.utils.fixtures import cube_mesh
+
+
+def _setup(n=4, nparts=4):
+    import dataclasses
+    vert, tet = cube_mesh(n)
+    m = make_mesh(vert, tet, capP=2 * len(vert), capT=2 * len(tet))
+    # two material refs -> MG_REF edges where the surface refs differ
+    tref = 1 + (vert[tet].mean(axis=1)[:, 0] > 0.5).astype(np.int32)
+    trf = np.zeros(m.capT, np.int32)
+    trf[: len(tet)] = tref
+    m = dataclasses.replace(m, tref=jnp.asarray(trf))
+    m = analyze_mesh(m).mesh
+    # per-material surface refs: boundary faces inherit their tet's ref,
+    # so surface edges on the material line see differing frefs (MG_REF)
+    is_b = (np.asarray(m.ftag) & C.MG_BDY) != 0
+    frf = np.where(is_b, trf[:, None], np.asarray(m.fref))
+    m = dataclasses.replace(m, fref=jnp.asarray(frf.astype(np.int32)))
+    met = jnp.full(m.capP, 0.4, m.vert.dtype)
+    vert_h, tet_h, _, _, _ = mesh_to_host(m)
+    cent = vert_h[tet_h].mean(axis=1)
+    part = fix_contiguity(tet_h, morton_partition(cent, nparts))
+    s, ms, l2g = split_to_shards(m, met, part, nparts, return_l2g=True)
+    g2l = []
+    for s_ in range(nparts):
+        mm = np.full(len(vert_h), -1, np.int64)
+        mm[l2g[s_]] = np.arange(len(l2g[s_]))
+        g2l.append(mm)
+    comms = build_interface_comms(tet_h, part, nparts, l2g, g2l)
+    return s, ms, comms, nparts
+
+
+def test_device_analysis_matches_host():
+    s, ms, comms, S = _setup()
+    dmesh = make_device_mesh(S)
+    stacked = shard_stacked(s, dmesh)
+    capP = stacked.vert.shape[1]
+    glo = extend_numbering(comms, [capP] * S)
+    ang = C.ANGEDG
+
+    host_out = refresh_shard_analysis(stacked, comms, S, ang,
+                                      glo=[g.copy() for g in glo])
+    dev_out = refresh_shard_analysis_device(stacked, comms, S, ang,
+                                            glo, dmesh)
+    assert dev_out is not None, "device path overflowed its budget"
+
+    vm = np.asarray(stacked.vmask)
+    tm = np.asarray(stacked.tmask)
+    vt_h = np.asarray(host_out.vtag)
+    vt_d = np.asarray(dev_out.vtag)
+    et_h = np.asarray(host_out.etag)
+    et_d = np.asarray(dev_out.etag)
+    for sh in range(S):
+        bad_v = np.where(vm[sh] & (vt_h[sh] != vt_d[sh]))[0]
+        assert len(bad_v) == 0, (
+            f"shard {sh}: {len(bad_v)} vtag mismatches, first "
+            f"{bad_v[:5]}: host {vt_h[sh][bad_v[:5]]} "
+            f"dev {vt_d[sh][bad_v[:5]]}")
+        bad_e = np.where((et_h[sh] != et_d[sh]) & tm[sh][:, None])
+        assert len(bad_e[0]) == 0, (
+            f"shard {sh}: {len(bad_e[0])} etag mismatches, first "
+            f"{[(int(a), int(b)) for a, b in zip(*[x[:5] for x in bad_e])]}"
+            f": host {et_h[sh][bad_e][:5]} dev {et_d[sh][bad_e][:5]}")
+
+
+def test_device_analysis_classifies_ridges_and_refs():
+    """Independent of the host path: cube ridges crossing shard
+    boundaries must be MG_GEO, material-boundary surface edges MG_REF,
+    cube corners MG_CRN."""
+    s, ms, comms, S = _setup()
+    dmesh = make_device_mesh(S)
+    stacked = shard_stacked(s, dmesh)
+    capP = stacked.vert.shape[1]
+    glo = extend_numbering(comms, [capP] * S)
+    dev_out = refresh_shard_analysis_device(stacked, comms, S, C.ANGEDG,
+                                            glo, dmesh)
+    assert dev_out is not None
+    vm = np.asarray(stacked.vmask)
+    vt = np.asarray(dev_out.vtag)
+    verts = np.asarray(stacked.vert)
+    n_geo = n_crn = 0
+    for sh in range(S):
+        v = verts[sh][vm[sh]]
+        t = vt[sh][vm[sh]]
+        on_edge = ((np.isclose(v, 0) | np.isclose(v, 1)).sum(axis=1) >= 2)
+        corner = ((np.isclose(v, 0) | np.isclose(v, 1)).sum(axis=1) == 3)
+        # cube corners are corners; cube-edge (non-corner) vertices are
+        # ridge points unless the material line promotes them
+        n_crn += int((t[corner] & C.MG_CRN != 0).sum())
+        geo_pts = on_edge & ~corner
+        n_geo += int(((t[geo_pts] & (C.MG_GEO | C.MG_CRN)) != 0).sum())
+        assert ((t[corner] & C.MG_CRN) != 0).all()
+        assert ((t[geo_pts] & (C.MG_GEO | C.MG_CRN)) != 0).all()
+    assert n_geo > 0 and n_crn > 0
+    # MG_REF must exist somewhere (the material interface meets the hull)
+    total_ref = sum(int(((vt[sh][vm[sh]] & C.MG_REF) != 0).sum())
+                    for sh in range(S))
+    assert total_ref > 0

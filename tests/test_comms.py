@@ -146,3 +146,36 @@ def test_multihost_single_process_degenerate():
     y = shard_stacked_global(x, dmesh)
     assert np.allclose(np.asarray(y["a"]), x["a"])
     assert len(y["a"].sharding.device_set) == 4
+
+
+def test_sort_based_builder_bit_identical_to_reference():
+    """VERDICT r2 #8 'Done' gate: the sort-based construction must
+    produce tables bit-identical to the dense/loop reference builder."""
+    from parmmg_tpu.parallel.comms import (build_interface_comms,
+                                           build_interface_comms_ref)
+    vert, tet, part, l2g, g2l = _partitioned(n=4, nparts=8)
+    a = build_interface_comms(tet, part, 8, l2g, g2l)
+    b = build_interface_comms_ref(tet, part, 8, l2g, g2l)
+    assert np.array_equal(a.nbr, b.nbr)
+    assert np.array_equal(a.node_idx, b.node_idx)
+    assert np.array_equal(a.node_cnt, b.node_cnt)
+    assert np.array_equal(a.face_idx, b.face_idx)
+    assert np.array_equal(a.face_cnt, b.face_cnt)
+    for oa, ob in zip(a.owner, b.owner):
+        assert np.array_equal(oa, ob)
+
+
+def test_builder_handles_64_parts():
+    """S=64 synthetic split: construction in seconds, echo clean."""
+    import time
+    from parmmg_tpu.parallel.comms import (build_interface_comms,
+                                           check_node_comms)
+    vert, tet, part, l2g, g2l = _partitioned(n=8, nparts=64)
+    t0 = time.perf_counter()
+    comms = build_interface_comms(tet, part, 64, l2g, g2l)
+    dt = time.perf_counter() - t0
+    assert dt < 30.0, f"construction took {dt:.1f}s"
+    verts = [vert[l2g[s]] for s in range(64)]
+    chk = check_node_comms(comms, verts)
+    assert chk["mismatch"] == 0
+    assert chk["items_checked"] > 0

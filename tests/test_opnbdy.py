@@ -1,0 +1,85 @@
+"""Open-boundary (-opnbdy) support — the reference's
+OpnBdy_peninsula/island CI class (cmake/testing/pmmg_tests.cmake:153-165):
+interior input triangles become a hanging MG_OPNBDY surface that the
+adaptation preserves and refines like a boundary.
+"""
+import numpy as np
+import jax.numpy as jnp
+
+from parmmg_tpu.api import ParMesh
+from parmmg_tpu.core import constants as C
+from parmmg_tpu.core.mesh import tet_volumes
+from parmmg_tpu.core.constants import IDIR
+from parmmg_tpu.utils.fixtures import cube_mesh
+
+
+def _peninsula_tris(vert, tet, zplane=0.5, xmax=0.5):
+    """Interior tet faces lying on z=zplane with x<=xmax: a sheet
+    attached to the hull at x=0 with a free rim at x=xmax."""
+    n = len(tet)
+    faces = tet[:, IDIR].reshape(n * 4, 3)
+    p = vert[faces]
+    onp = (np.abs(p[:, :, 2] - zplane) < 1e-9).all(axis=1) & \
+          (p[:, :, 0] <= xmax + 1e-9).all(axis=1)
+    tri = faces[onp]
+    # dedup the two slots of each interior face
+    key = np.sort(tri, axis=1)
+    _, first = np.unique(key, axis=0, return_index=True)
+    return tri[np.sort(first)]
+
+
+def _staged(opnbdy, hsiz=0.3):
+    vert, tet = cube_mesh(4)
+    tris = _peninsula_tris(vert, tet)
+    assert len(tris) > 4
+    pm = ParMesh()
+    pm.set_mesh_size(np_=len(vert), ne=len(tet), nt=len(tris))
+    pm.set_vertices(vert)
+    pm.set_tetrahedra(tet + 1)
+    pm.set_triangles(tris + 1, refs=np.full(len(tris), 9))
+    pm.info.niter = 1
+    pm.info.imprim = -1
+    pm.info.hsiz = hsiz
+    pm.info.opnbdy = opnbdy
+    return pm, len(tris)
+
+
+def _opn_faces(mesh):
+    ft = np.asarray(mesh.ftag)
+    tm = np.asarray(mesh.tmask)
+    return np.where((ft & C.MG_OPNBDY) != 0, tm[:, None], False)
+
+
+def test_opnbdy_ingested_and_preserved():
+    pm, ntri0 = _staged(True)
+    assert pm.run() == C.PMMG_SUCCESS
+    m = pm._out
+    opn = _opn_faces(m)
+    assert opn.any(), "open-boundary faces lost during adaptation"
+    # geometric preservation: every opnbdy face vertex stays on the
+    # sheet plane, inside the peninsula footprint
+    tet = np.asarray(m.tet)
+    vert = np.asarray(m.vert)
+    t_ids, f_ids = np.where(opn)
+    tri = tet[t_ids][np.arange(len(t_ids))[:, None], IDIR[f_ids]]
+    p = vert[np.unique(tri.reshape(-1))]
+    assert np.abs(p[:, 2] - 0.5).max() < 1e-5
+    assert p[:, 0].max() <= 0.5 + 1e-5
+    # refined: the sheet carries more faces than the input (both slots
+    # of each geometric face are tagged -> compare at 2x input)
+    assert opn.sum() > 2 * ntri0
+    # rim must be non-manifold-frozen: vertices at the free edge x=0.5
+    vtag = np.asarray(m.vtag)[np.asarray(m.vmask)]
+    vv = vert[np.asarray(m.vmask)]
+    rim = (np.abs(vv[:, 0] - 0.5) < 1e-6) & (np.abs(vv[:, 2] - 0.5) < 1e-6)
+    assert rim.any()
+    assert ((vtag[rim] & C.MG_NOM) != 0).all()
+    # volume conserved
+    vols = np.asarray(tet_volumes(m))[np.asarray(m.tmask)]
+    assert (vols > 0).all() and np.isclose(vols.sum(), 1.0, rtol=1e-4)
+
+
+def test_without_flag_interior_tris_stay_decorative():
+    pm, _ = _staged(False)
+    assert pm.run() == C.PMMG_SUCCESS
+    assert not _opn_faces(pm._out).any()

@@ -2,12 +2,9 @@
 XLA:CPU backend on this image segfaults late in long test processes —
 same reason test_curved/test_curved_dist are split)."""
 import numpy as np
-import jax.numpy as jnp
-import pytest
 
-from parmmg_tpu.api import ParMesh, IParam, DParam
+from parmmg_tpu.api import ParMesh
 from parmmg_tpu.core import constants as C
-from parmmg_tpu.core.mesh import tet_volumes
 from parmmg_tpu.utils.fixtures import cube_mesh
 
 from test_options import _staged, _run_ok

@@ -1,0 +1,112 @@
+"""Curved feature lines: ridge tangents + tangent-circle midpoint lift.
+
+Reference contract: Mmg keeps a line tangent (and two per-side normals)
+at ridge points, maintained across ranks by PMMG_hashNorver
+(analys_pmmg.c:199-1171); new points on a curved ridge land on the
+feature curve, not on its chord — without this the torus-equator /
+cylinder-rim class stays piecewise-linear at any metric resolution.
+"""
+import numpy as np
+import jax.numpy as jnp
+
+from parmmg_tpu.core.mesh import make_mesh
+from parmmg_tpu.core import constants as C
+from parmmg_tpu.ops.analysis import analyze_mesh, ridge_vertex_tangents
+from parmmg_tpu.ops.split import split_wave
+from parmmg_tpu.utils.fixtures import cylinder_mesh
+
+R = 0.5
+
+
+def _cyl(n=6):
+    vert, tet = cylinder_mesh(n, r=R)
+    m = make_mesh(vert, tet, capP=3 * len(vert), capT=3 * len(tet))
+    m = analyze_mesh(m).mesh
+    return m
+
+
+def test_rim_is_ridge_and_tangents_follow_circle():
+    m = _cyl()
+    vm = np.asarray(m.vmask)
+    vt = np.asarray(m.vtag)
+    v = np.asarray(m.vert)
+    rho = np.hypot(v[:, 0], v[:, 1])
+    rim = vm & (np.abs(v[:, 2] - 1.0) < 1e-9) & (np.abs(rho - R) < 1e-6)
+    assert rim.sum() >= 8
+    assert ((vt[rim] & (C.MG_GEO | C.MG_CRN)) != 0).all()
+    tan = np.asarray(ridge_vertex_tangents(m))
+    t = tan[rim & ((vt & C.MG_GEO) != 0) & ((vt & C.MG_CRN) == 0)]
+    assert len(t) > 0
+    # tangent of the rim circle: no z component, orthogonal-ish to the
+    # radial direction (chordal discretization allows some slack)
+    pts = v[rim & ((vt & C.MG_GEO) != 0) & ((vt & C.MG_CRN) == 0)]
+    radial = pts[:, :2] / np.linalg.norm(pts[:, :2], axis=1,
+                                         keepdims=True)
+    assert np.abs(t[:, 2]).max() < 0.2
+    along_r = np.abs(np.einsum("ij,ij->i", t[:, :2], radial))
+    assert along_r.max() < 0.35
+
+
+def _rim_metric(m):
+    """Small target size near the cap rim only, so rim edges dominate
+    the split wave's priority budget."""
+    v = np.asarray(m.vert)
+    rho = np.hypot(v[:, 0], v[:, 1])
+    near = (np.abs(v[:, 2] - 1.0) < 0.2) & (np.abs(rho - R) < 0.15)
+    met = np.where(near, 0.05, 0.5)
+    return jnp.asarray(met, jnp.asarray(m.vert).dtype)
+
+
+def test_split_lifts_rim_midpoints_onto_circle():
+    m = _cyl()
+    np0 = int(np.asarray(m.npoin))
+    met = _rim_metric(m)
+    m2, nsp = m, 0
+    for _ in range(6):          # waves: rim edges win once their
+        res = split_wave(m2, met, hausd=0.05)   # neighbors shorten
+        m2, met = res.mesh, res.met
+        nsp += int(res.nsplit)
+    assert nsp > 0
+    vm2 = np.asarray(m2.vmask)
+    vt2 = np.asarray(m2.vtag)
+    v2 = np.asarray(m2.vert)
+    new = np.zeros(m2.capP, bool)
+    new[np0:] = vm2[np0:]
+    rho2 = np.hypot(v2[:, 0], v2[:, 1])
+    new_rim = new & ((vt2 & C.MG_GEO) != 0) & \
+        (np.abs(v2[:, 2] - 1.0) < 1e-6) & (rho2 > 0.5 * R)
+    if not new_rim.any():
+        import pytest
+        pytest.skip("no rim edge split in this wave")
+    # chordal sag of the unlifted midpoint for the coarsest rim edge
+    # (24-gon at n=6): r (1 - cos(pi/24)); the tangent-circle lift must
+    # recover most of it
+    sag_linear = R * (1 - np.cos(np.pi / 24))
+    dev = np.abs(rho2[new_rim] - R)
+    assert dev.max() < 0.35 * sag_linear, (
+        f"rim midpoints not lifted: dev {dev.max():.3e} vs linear sag "
+        f"{sag_linear:.3e}")
+
+
+def test_without_hausd_midpoints_stay_on_chord():
+    m = _cyl()
+    np0 = int(np.asarray(m.npoin))
+    met = _rim_metric(m)
+    m2 = m
+    for _ in range(6):
+        res = split_wave(m2, met)       # no hausd: linear midpoints
+        m2, met = res.mesh, res.met
+    vm2 = np.asarray(m2.vmask)
+    vt2 = np.asarray(m2.vtag)
+    v2 = np.asarray(m2.vert)
+    new = np.zeros(m2.capP, bool)
+    new[np0:] = vm2[np0:]
+    rho2 = np.hypot(v2[:, 0], v2[:, 1])
+    new_rim = new & ((vt2 & C.MG_GEO) != 0) & \
+        (np.abs(v2[:, 2] - 1.0) < 1e-6) & (rho2 > 0.5 * R)
+    if not new_rim.any():
+        import pytest
+        pytest.skip("no rim edge split in this wave")
+    sag_linear = R * (1 - np.cos(np.pi / 24))
+    dev = np.abs(rho2[new_rim] - R)
+    assert dev.max() > 0.5 * sag_linear   # chord midpoints sag inward
