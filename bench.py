@@ -150,6 +150,12 @@ def main() -> None:
             swap_every=3, swap_offset=off, budget_div=bdiv)
         cs = np.asarray(counts)                   # blocks on this block
         times.append(time.perf_counter() - t0)
+        if os.environ.get("BENCH_DEBUG", "") == "1":
+            for r in cs:
+                print(f"bench:   cycle counts split={int(r[0]):6d} "
+                      f"col={int(r[1]):6d} swap={int(r[2]):6d} "
+                      f"move={int(r[3]):6d} live={int(r[5]):6d}",
+                      file=sys.stderr)
         # tets examined this block = sum over cycles of live-at-entry
         entries = [prev_live] + [int(r[5]) for r in cs[:-1]]
         live.append(int(np.sum(entries)))

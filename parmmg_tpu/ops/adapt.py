@@ -89,7 +89,9 @@ def adapt_cycle_impl(mesh: Mesh, met: jax.Array, wave: jax.Array,
         # (the tables are a measured wave hot spot); the collapse defers
         # candidates whose table rows the split made stale
         from .edges import unique_edges, edge_lengths
-        et0 = unique_edges(mesh)
+        # slim table: split/collapse never read shell3 (only the swap
+        # kernels, which build their own) — skips a [6*capT] scatter
+        et0 = unique_edges(mesh, shell_slots=0)
         lens0 = edge_lengths(mesh, et0, met)
         # ridge tangents once per cycle too (same sharing rationale;
         # collapse only consults non-stale candidates, whose tangent
@@ -111,8 +113,11 @@ def adapt_cycle_impl(mesh: Mesh, met: jax.Array, wave: jax.Array,
         # the surviving neighbors); re-propagate MG_BDY from faces to
         # their edges and vertices so later splits/smooth treat the new
         # surface entities as boundary — without this, untagged surface
-        # midpoints become "movable" and smoothing dents the surface
-        mesh = boundary_edge_tags(col.mesh)
+        # midpoints become "movable" and smoothing dents the surface.
+        # Skipped when no dying tet donated tags (interior collapses):
+        # the propagation pass costs a [12*capT]-index scatter
+        mesh = jax.lax.cond(col.surface_changed, boundary_edge_tags,
+                            lambda m: m, col.mesh)
         ncol = col.ncollapse
     else:
         # -noinsert: no point insertion or deletion (Mmg contract)
@@ -261,7 +266,8 @@ def sliver_polish_impl(mesh: Mesh, met: jax.Array, wave: jax.Array,
         # sliver population instead of the worst K only
         col = collapse_wave(mesh, met, sliver_q=sliver_q, hausd=hausd,
                             budget_div=2)
-        mesh = boundary_edge_tags(col.mesh)
+        mesh = jax.lax.cond(col.surface_changed, boundary_edge_tags,
+                            lambda m: m, col.mesh)
         ncol = col.ncollapse
     if do_swap:
         from .swapgen import swapgen_wave

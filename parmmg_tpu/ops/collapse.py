@@ -41,6 +41,9 @@ _IDIR_J = jnp.asarray(IDIR)
 class CollapseResult(NamedTuple):
     mesh: Mesh
     ncollapse: jax.Array
+    # did any dying tet donate face/edge tags (surface rewired)?  False
+    # lets the caller skip the boundary re-propagation pass entirely
+    surface_changed: jax.Array = None
 
 
 def _removable(vtag, other_vtag, edge_tag):
@@ -154,7 +157,8 @@ def collapse_wave(mesh: Mesh, met: jax.Array, lmin: float = LSHRT,
     # exists — at convergence the wave then costs only the table +
     # candidacy masks.
     def _idle(_):
-        return CollapseResult(mesh, jnp.zeros((), jnp.int32))
+        return CollapseResult(mesh, jnp.zeros((), jnp.int32),
+                              jnp.zeros((), bool))
 
     def _act(_):
         # top-K compaction (scripts/wave_time.py cost lever): the K highest-
@@ -173,7 +177,8 @@ def collapse_wave(mesh: Mesh, met: jax.Array, lmin: float = LSHRT,
                 jnp.repeat(jnp.where(bad_tet, q_tet, jnp.inf), 6),
                 mode="drop")
             prio = eq_min
-        sel = jnp.argsort(jnp.where(pre, prio, jnp.inf))[:K]
+        # top-K by priority (smallest first) without a full-width argsort
+        _, sel = jax.lax.top_k(jnp.where(pre, -prio, -jnp.inf), K)
         lens_c = lens[sel]
         va = va_f[sel]
         vb = vb_f[sel]
@@ -192,75 +197,73 @@ def collapse_wave(mesh: Mesh, met: jax.Array, lmin: float = LSHRT,
             jnp.where(is_top, rm, capP)].set(kp, mode="drop",
                                              unique_indices=True)
 
-        # --- geometric validity of top removers, tet-centric -----------------
-        # for each (tet, corner k): v = tet[k]; if v is a top-removal target,
-        # simulate v -> kept_of[v] and test volumes / fold-over / new lengths.
+        # --- claims + validity, claimed-corner only --------------------------
+        # tet claim = (s,t)-max removal target over the 4 corners.  A
+        # remover contested at ANY ball tet (some corner holds a target
+        # that is not that tet's claim max) can never win, so geometric
+        # validity and the simulated ball quality only need evaluating at
+        # each tet's single CLAIMED corner — [T]-width instead of the old
+        # [4T] stacked variants, with the contested/invalid cases folded
+        # into the same ball-quality scatter as -inf rows
+        # (scripts/split_stage_time.py: validity+ballq was ~28 ms).
         tv = mesh.tet                                          # [T,4]
         vpos = mesh.vert[tv]                                   # [T,4,3]
         vs_c = v_s[tv]                                         # [T,4] score max
         vt_c = v_t[tv]                                         # [T,4] tie max
         has_c = jnp.isfinite(vs_c)        # corner is a top-removal target
-        kept = kept_of[tv]                                     # [T,4]
-        kept_pos = mesh.vert[kept]                             # [T,4,3]
-        # does this tet also contain the kept vertex? then it dies, skip checks
-        contains_kept = jnp.zeros((capT, 4), bool)
-        for k in range(4):
-            hit = jnp.zeros((capT,), bool)
-            for j in range(4):
-                hit = hit | ((tv[:, j] == kept[:, k]) & (j != k))
-            contains_kept = contains_kept.at[:, k].set(hit)
+        tmax_s = jnp.max(jnp.where(mesh.tmask[:, None], vs_c, NEG_INF), axis=1)
+        selc = (vs_c == tmax_s[:, None]) & jnp.isfinite(tmax_s)[:, None]
+        tsel = jnp.where(selc, vt_c, PRI_MIN)
+        tmax_t = jnp.max(tsel, axis=1)
+        corner_max = selc & (tsel == tmax_t[:, None])
+        claimed = corner_max & has_c                           # [T,4]
+        has_cl = jnp.any(claimed, axis=1) & mesh.tmask
+        kc = jnp.argmax(claimed, axis=1)                       # [T]
+        ar0 = jnp.arange(capT)
+        rm_v = tv[ar0, kc]                                     # claimed target
+        kept_v = kept_of[jnp.clip(rm_v, 0, capP - 1)]          # its kept vtx
+        kept_p = mesh.vert[jnp.clip(kept_v, 0, capP - 1)]      # [T,3]
+        # does this tet also contain the kept vertex? then it dies with the
+        # collapse — it drops out of the surviving ball, no checks needed
+        contains_kept = jnp.zeros(capT, bool)
+        for j in range(4):
+            contains_kept = contains_kept | \
+                ((tv[:, j] == kept_v) & (j != kc))
+        active_cl = has_cl & ~contains_kept
 
-        # elementwise validity math stays per-corner (XLA fuses it); only the
-        # SCATTERS are concatenated into one long op — per-op overhead
-        # dominates scatter cost on this device (scripts/tpu_microbench.py)
-        idx_act = []
-        bad_all = []
-        act_all = []
-        for k in range(4):
-            active = has_c[:, k] & mesh.tmask & ~contains_kept[:, k]
-            p = vpos.at[:, k].set(kept_pos[:, k])              # moved corner
-            d1 = p[:, 1] - p[:, 0]
-            d2 = p[:, 2] - p[:, 0]
-            d3 = p[:, 3] - p[:, 0]
-            vol = jnp.einsum("ti,ti->t", d1, jnp.cross(d2, d3)) / 6.0
-            bad = vol <= EPSD
-            # fold-over: boundary faces containing corner k keep orientation
-            for f in range(4):
-                if k == f:
-                    continue  # face opposite k does not contain k
-                idx = IDIR[f]
-                n_old = jnp.cross(vpos[:, idx[1]] - vpos[:, idx[0]],
-                                  vpos[:, idx[2]] - vpos[:, idx[0]])
-                n_new = jnp.cross(p[:, idx[1]] - p[:, idx[0]],
-                                  p[:, idx[2]] - p[:, idx[0]])
-                isb = (mesh.ftag[:, f] & MG_BDY) != 0
-                flip = jnp.sum(n_old * n_new, -1) <= 0
-                bad = bad | (isb & flip)
-            # overlong new edges from the kept vertex to the other corners
-            if met.ndim == 1:
-                from .quality import edge_length_iso
-                for j in range(4):
-                    if j == k:
-                        continue
-                    lnew = edge_length_iso(
-                        kept_pos[:, k], p[:, j],
-                        met[kept[:, k]], met[tv[:, j]])
-                    bad = bad | (lnew > lmax)
-            idx_act.append(jnp.where(active, tv[:, k], capP))
-            bad_all.append(bad)
-            act_all.append(active)
-        idx_act = jnp.concatenate(idx_act)                     # [4T]
-        geombad = jnp.zeros(capP + 1, bool).at[idx_act].max(
-            jnp.concatenate(bad_all), mode="drop")[:capP]
+        # single simulated variant per tet: claimed corner -> kept position
+        oh = jnp.arange(4)[None, :] == kc[:, None]             # [T,4]
+        p = jnp.where(oh[..., None], kept_p[:, None, :], vpos)
+        d1 = p[:, 1] - p[:, 0]
+        d2 = p[:, 2] - p[:, 0]
+        d3 = p[:, 3] - p[:, 0]
+        vol = jnp.einsum("ti,ti->t", d1, jnp.cross(d2, d3)) / 6.0
+        bad = vol <= EPSD
+        # fold-over: boundary faces containing the claimed corner must
+        # keep their orientation
+        for f in range(4):
+            idx = IDIR[f]
+            n_old = jnp.cross(vpos[:, idx[1]] - vpos[:, idx[0]],
+                              vpos[:, idx[2]] - vpos[:, idx[0]])
+            n_new = jnp.cross(p[:, idx[1]] - p[:, idx[0]],
+                              p[:, idx[2]] - p[:, idx[0]])
+            isb = (mesh.ftag[:, f] & MG_BDY) != 0
+            flip = jnp.sum(n_old * n_new, -1) <= 0
+            bad = bad | (isb & flip & (kc != f))
+        # overlong new edges from the kept vertex to the other corners
+        if met.ndim == 1:
+            from .quality import edge_length_iso
+            for j in range(4):
+                lnew = edge_length_iso(kept_p, p[:, j],
+                                       met[jnp.clip(kept_v, 0, capP - 1)],
+                                       met[tv[:, j]])
+                bad = bad | ((lnew > lmax) & (kc != j))
 
         # --- ball-quality gate ----------------------------------------------
-        # Simulate the surviving ball of each removal target and compare min
-        # qualities (dying tets drop out).  Normal mode: the collapse must not
-        # degrade the ball min quality below 30% of its old value nor below
-        # the degeneracy floor (MMG5_colver's calnew/calold check — without
-        # it, aggressive coarsening flattens boundary regions into
-        # zero-volume slivers that interior-only swaps never repair).  Sliver
-        # mode: STRICT improvement (the pass exists to raise the min).
+        # Normal mode: the collapse must not degrade the ball min quality
+        # below 30% of its old value nor below the degeneracy floor
+        # (MMG5_colver's calnew/calold check).  Sliver mode: STRICT
+        # improvement.  Invalid geometry and contested balls force -inf.
         from .quality import quality_from_points
         mq = None if met.ndim == 1 else met[tv]
         # q_tet is a closure variable in sliver mode — don't shadow it
@@ -271,35 +274,31 @@ def collapse_wave(mesh: Mesh, met: jax.Array, lmin: float = LSHRT,
         ballq_old = jnp.full(capP + 1, jnp.inf).at[idx4c].min(
             jnp.tile(jnp.where(mesh.tmask, q_ball, jnp.inf), 4),
             mode="drop")
-        # the 4 moved-corner variants as ONE stacked quality call + scatter
-        variants = jnp.concatenate(
-            [vpos.at[:, k].set(kept_pos[:, k]) for k in range(4)])
-        mq4 = None if mq is None else jnp.concatenate(
-            [mq.at[:, k].set(met[kept[:, k]]) for k in range(4)])
-        qv = quality_from_points(variants, mq4)                # [4T]
-        act4 = jnp.concatenate(act_all)
-        ballq_new = jnp.full(capP + 1, jnp.inf).at[idx_act].min(
-            jnp.where(act4, qv, jnp.inf), mode="drop")
+        mq_cl = None if mq is None else jnp.where(
+            oh[..., None], met[jnp.clip(kept_v, 0, capP - 1)][:, None, :],
+            mq)
+        qv = quality_from_points(p, mq_cl)                     # [T]
+        row_val = jnp.where(bad, -jnp.inf, qv)
+        # contested rows: a corner holding a target that is NOT the tet's
+        # claim max kills that target via a -inf contribution
+        mism4 = jnp.concatenate(
+            [has_c[:, k] & ~corner_max[:, k] & mesh.tmask for k in range(4)])
+        idx_cat = jnp.concatenate(
+            [jnp.where(active_cl, rm_v, capP),
+             jnp.where(mism4, jnp.concatenate([tv[:, k] for k in range(4)]),
+                       capP)])
+        val_cat = jnp.concatenate(
+            [jnp.where(active_cl, row_val, jnp.inf),
+             jnp.where(mism4, -jnp.inf, jnp.inf)])
+        ballq_new = jnp.full(capP + 1, jnp.inf).at[idx_cat].min(
+            val_cat, mode="drop")
         if sliver_q is None:
             ok = (ballq_new[:capP] >= 0.3 * ballq_old[:capP]) & \
                  (ballq_new[:capP] > QUAL_FLOOR)
-            geombad = geombad | ~ok
+            geombad = ~ok
         else:
             improves = ballq_new[:capP] > ballq_old[:capP]
-            geombad = geombad | ~improves
-
-        # --- claims (two-channel, sort-free) ---------------------------------
-        # tet claim = (s,t)-max removal target over the 4 corners; a corner
-        # with a target loses its tets if it is not the tet's max holder
-        tmax_s = jnp.max(jnp.where(mesh.tmask[:, None], vs_c, NEG_INF), axis=1)
-        sel = (vs_c == tmax_s[:, None]) & jnp.isfinite(tmax_s)[:, None]
-        tsel = jnp.where(sel, vt_c, PRI_MIN)
-        tmax_t = jnp.max(tsel, axis=1)
-        corner_max = sel & (tsel == tmax_t[:, None])
-        mism4 = jnp.concatenate(
-            [has_c[:, k] & ~corner_max[:, k] & mesh.tmask for k in range(4)])
-        contested = jnp.zeros(capP + 1, bool).at[idx4c].max(
-            mism4, mode="drop")[:capP]
+            geombad = ~improves
 
         # vertex claims: a winner must be the (s,t)-max among all candidate
         # edges touching either of its endpoints (both roles) — one
@@ -316,7 +315,8 @@ def collapse_wave(mesh: Mesh, met: jax.Array, lmin: float = LSHRT,
             jnp.tile(t, 2), mode="drop")
         claim_ok = eq_rm & (t == cl_t[rm]) & eq_kp & (t == cl_t[kp])
 
-        win = cand & is_top & ~geombad[rm] & ~contested[rm] & claim_ok
+        # contested balls are already folded into geombad via -inf rows
+        win = cand & is_top & ~geombad[rm] & claim_ok
         ncol = jnp.sum(win.astype(jnp.int32))
 
         # --- apply: vertex remap + dead shell tets ---------------------------
@@ -329,15 +329,15 @@ def collapse_wave(mesh: Mesh, met: jax.Array, lmin: float = LSHRT,
 
         def _skip_collapse(_):
             return (mesh.tet, mesh.tmask, mesh.vmask, mesh.ftag, mesh.fref,
-                    mesh.etag)
+                    mesh.etag, jnp.zeros((), bool))
 
-        new_tet, tmask, vmask, ftag, fref, etag = jax.lax.cond(
+        new_tet, tmask, vmask, ftag, fref, etag, schg = jax.lax.cond(
             ncol > 0, _apply_collapse, _skip_collapse, None)
 
         out = dataclasses.replace(
             mesh, tet=new_tet, tmask=tmask, vmask=vmask, ftag=ftag,
             fref=fref, etag=etag)
-        return CollapseResult(out, ncol)
+        return CollapseResult(out, ncol, schg)
 
     return jax.lax.cond(jnp.any(pre), _act, _idle, None)
 
@@ -373,7 +373,7 @@ def _collapse_apply(mesh: Mesh, met, win, rm, kp, capT, capP):
 
     ftag, fref, etag = jax.lax.cond(has_donor_info, _joins, _no_joins,
                                     None)
-    return new_tet, tmask, vmask, ftag, fref, etag
+    return new_tet, tmask, vmask, ftag, fref, etag, has_donor_info
 
 
 def _collapse_tag_joins(mesh: Mesh, new_tet, dead, tmask, capT, capP):

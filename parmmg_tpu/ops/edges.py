@@ -107,66 +107,84 @@ class EdgeTable(NamedTuple):
     shell_rank: jax.Array  # [capT, 6] int32 rank of this tet in the edge's
     #                     shell (ascending tet id) — free by-product of the
     #                     sort; lets split_wave skip its own ranking sort
+    skey: jax.Array = None  # [capE] ascending packed keys a*capP+b of the
+    #                     internal sort (duplicates included, INT32_MAX on
+    #                     invalid slots); empty [0] when capP > PACK_LIMIT.
+    #                     Lets swap22's duplicate-diagonal existence probe
+    #                     binary-search without re-sorting the table
 
 
 def unique_edges(mesh: Mesh, shell_slots: int = 3) -> EdgeTable:
+    """``shell_slots=0`` skips the shell-tet-id scatter entirely (returns
+    ``shell3`` with zero columns) — split/collapse never read it, only the
+    swap kernels do, and every scatter at [6*capT] width is a measured
+    multi-ms item on this device (scripts/tpu_microbench.py,
+    scripts/split_stage_time.py)."""
     capT = mesh.capT
-    ev = tet_edge_vertices(mesh.tet).reshape(capT * 6, 2)
+    n6 = capT * 6
+    ev = tet_edge_vertices(mesh.tet).reshape(n6, 2)
     a = jnp.minimum(ev[:, 0], ev[:, 1])
     b = jnp.maximum(ev[:, 0], ev[:, 1])
     valid = jnp.repeat(mesh.tmask, 6)
     order, ka, kb, first = sort_pairs(a, b, valid, mesh.capP)
-    # unique-edge id of each sorted slot = index of its segment head
-    seg_head = jnp.where(first, jnp.arange(capT * 6), 0)
-    seg_head = jax.lax.associative_scan(jnp.maximum, seg_head)
-    # representative id = position of the segment head in SORTED order; we
-    # use the sorted position itself as the unique edge id (stable, dense
-    # enough). Scatter back to (tet, local edge) slots.
-    eid_sorted = seg_head
-    # `order` is a permutation: unique_indices lets XLA apply the scatter
-    # fully in parallel (TPU scatter is serialized when it must assume
-    # duplicate targets)
-    eid = jnp.zeros(capT * 6, jnp.int32).at[order].set(
-        eid_sorted.astype(jnp.int32), unique_indices=True)
-    edge_id = eid.reshape(capT, 6)
+    valid_s = ka != _INT32_MAX          # sorted-order validity, no gather
+    # unique-edge id of each sorted slot = index of its segment head.
+    # ONE tuple-carry scan produces the segment head AND the running
+    # etag-OR together (two separate scans were a measured cost).
+    pos = jnp.arange(n6)
+    tags = jnp.where(valid_s, mesh.etag.reshape(n6)[order], 0)
 
-    emask = first & (ka != _INT32_MAX)
-    ev_u = jnp.stack([ka, kb], axis=1)
-    # shell size + tag OR per unique edge (segment sums via scatter-add)
-    ones = (valid[order]).astype(jnp.int32)
-    nshell = jnp.zeros(capT * 6, jnp.int32).at[eid_sorted].add(
-        ones, indices_are_sorted=True)
-    tags = mesh.etag.reshape(capT * 6)[order]
-    tags = jnp.where(valid[order], tags, 0)
-    # true bitwise-OR over each segment (a scatter-max would let a slot
-    # with a numerically larger tag shadow e.g. the MG_REQ bit of another
-    # slot of the same edge): segmented inclusive OR scan, then the last
-    # element of each segment holds the full OR and is scattered to the
-    # segment head (= the unique-edge id)
-    or_scan = segmented_or(first, tags)
-    n6 = capT * 6
+    def seg_comb2(pa, pb):
+        fa, ha, va = pa
+        fb, hb, vb = pb
+        return (fa | fb, jnp.where(fb, hb, jnp.maximum(ha, hb)),
+                jnp.where(fb, vb, va | vb))
+
+    _, seg_head, or_scan = jax.lax.associative_scan(
+        seg_comb2, (first, jnp.where(first, pos, 0), tags))
+    eid_sorted = seg_head
+    rank = pos - seg_head
     is_last = jnp.concatenate([first[1:], jnp.array([True])])
-    etag = jnp.zeros(n6, jnp.uint32).at[
+
+    emask = first & valid_s
+    ev_u = jnp.stack([ka, kb], axis=1)
+    # per-unique-edge values (full OR of tags; shell count = last rank+1)
+    # land at the head slot with ONE packed 2-column scatter
+    head_pay = jnp.stack([or_scan.astype(jnp.int32),
+                          (rank + 1).astype(jnp.int32)], axis=1)
+    head_tbl = jnp.zeros((n6, 2), jnp.int32).at[
         jnp.where(is_last, eid_sorted, n6)].set(
-        or_scan, mode="drop", unique_indices=True)
+        head_pay, mode="drop", unique_indices=True)
+    etag = head_tbl[:, 0].astype(jnp.uint32)
+    nshell = head_tbl[:, 1]
+    # per (tet, local edge) slot: unique edge id + rank within the shell
+    # (stable lexsort keeps equal keys in slot order = ascending tet id),
+    # scattered back through the permutation in ONE packed scatter
+    back_pay = jnp.stack([eid_sorted.astype(jnp.int32),
+                          rank.astype(jnp.int32)], axis=1)
+    back = jnp.zeros((n6, 2), jnp.int32).at[order].set(
+        back_pay, unique_indices=True)
+    edge_id = back[:, 0].reshape(capT, 6)
+    shell_rank = back[:, 1].reshape(capT, 6)
     # first-S shell tet ids per edge (3 for the 3-2 swap; 6-7 for the
     # generalized ring swaps): rank within segment
-    pos = jnp.arange(capT * 6)
-    rank = pos - seg_head
-    tet_of_slot = (order // 6).astype(jnp.int32)
-    shell3 = jnp.full((capT * 6, shell_slots), -1, jnp.int32)
-    tgt_e = jnp.where(valid[order] & (rank < shell_slots), eid_sorted,
-                      capT * 6)
-    shell3 = shell3.at[tgt_e, jnp.clip(rank, 0, shell_slots - 1)].set(
-        tet_of_slot, mode="drop", unique_indices=True)
-    # per (tet, local edge) slot: rank of the tet within its edge's shell.
-    # The stable lexsort keeps equal keys in slot order (= ascending tet
-    # id), so this equals a rank-among-shell-tets-by-tet-id — computed here
-    # for free and reused by split_wave's slot assignment.
-    shell_rank = jnp.zeros(capT * 6, jnp.int32).at[order].set(
-        rank.astype(jnp.int32), unique_indices=True).reshape(capT, 6)
+    if shell_slots > 0:
+        tet_of_slot = (order // 6).astype(jnp.int32)
+        shell3 = jnp.full((n6, shell_slots), -1, jnp.int32)
+        tgt_e = jnp.where(valid_s & (rank < shell_slots), eid_sorted, n6)
+        shell3 = shell3.at[tgt_e, jnp.clip(rank, 0, shell_slots - 1)].set(
+            tet_of_slot, mode="drop", unique_indices=True)
+    else:
+        shell3 = jnp.zeros((n6, 0), jnp.int32)
+    if shell_slots > 0 and mesh.capP <= PACK_LIMIT:
+        # only the swap kernels consume skey; the slim split/collapse
+        # tables (shell_slots=0) skip materializing it
+        skey = jnp.where(valid_s, ka * mesh.capP + kb, _INT32_MAX)
+    else:
+        skey = jnp.zeros((0,), jnp.int32)
     return EdgeTable(ev=ev_u, emask=emask, etag=etag, nshell=nshell,
-                     edge_id=edge_id, shell3=shell3, shell_rank=shell_rank)
+                     edge_id=edge_id, shell3=shell3, shell_rank=shell_rank,
+                     skey=skey)
 
 
 def edge_lengths(mesh: Mesh, et: EdgeTable, met: jax.Array) -> jax.Array:
@@ -181,10 +199,18 @@ def edge_lengths(mesh: Mesh, et: EdgeTable, met: jax.Array) -> jax.Array:
     from .pallas_kernels import (use_pallas, pallas_forced,
                                  edge_length_iso_pallas,
                                  edge_length_ani_pallas)
-    p0 = mesh.vert[jnp.clip(et.ev[:, 0], 0, mesh.capP - 1)]
-    p1 = mesh.vert[jnp.clip(et.ev[:, 1], 0, mesh.capP - 1)]
     i0 = jnp.clip(et.ev[:, 0], 0, mesh.capP - 1)
     i1 = jnp.clip(et.ev[:, 1], 0, mesh.capP - 1)
+    if met.ndim == 1:
+        # pack (x, y, z, h) so each endpoint costs ONE row gather
+        # (gather cost is linear in index count on this device)
+        vm = jnp.concatenate([mesh.vert, met[:, None]], axis=1)
+        r0, r1 = vm[i0], vm[i1]
+        p0, p1 = r0[:, :3], r1[:, :3]
+        m0, m1 = r0[:, 3], r1[:, 3]
+    else:
+        p0, p1 = mesh.vert[i0], mesh.vert[i1]
+        m0, m1 = met[i0], met[i1]
     pal = (edge_length_iso_pallas if met.ndim == 1
            else edge_length_ani_pallas)
     ref = edge_length_iso if met.ndim == 1 else edge_length_ani
@@ -195,9 +221,9 @@ def edge_lengths(mesh: Mesh, et: EdgeTable, met: jax.Array) -> jax.Array:
         # when PARMMG_TPU_PALLAS=1 forces kernel numerics everywhere
         off_tpu = partial(pal, interpret=True) if pallas_forced() else ref
         return jax.lax.platform_dependent(
-            p0, p1, met[i0], met[i1],
+            p0, p1, m0, m1,
             tpu=partial(pal, interpret=False), default=off_tpu)
-    return ref(p0, p1, met[i0], met[i1])
+    return ref(p0, p1, m0, m1)
 
 
 def claim_shells(score, cand, shells, capT):

@@ -29,11 +29,14 @@ from ..core.constants import (
 from .quality import quality_from_points
 from .edges import PRI_MIN
 
-# a regular surface point only slides in its tangent plane when every
-# incident boundary face lies within ~2.6 deg of the average normal — the
-# move is then surface-exact; curved patches wait for hausd-driven
-# reprojection (Mmg reprojects onto the surface ball instead)
-FLAT_COS = 0.999
+# a regular surface point only slides in its tangent plane when its
+# incident boundary faces are mutually near-parallel — the move is then
+# surface-exact; curved patches wait for hausd-driven reprojection (Mmg
+# reprojects onto the surface ball instead).  Gate: |sum of unit
+# normals| / count >= FLAT_RATIO, i.e. a single outlier face in a
+# 12-face ball may tilt ~4 deg (the old per-face min-dot gate allowed
+# 2.6 deg but cost a second full-width gather+scatter pass per wave)
+FLAT_RATIO = 0.9998
 
 
 class SmoothResult(NamedTuple):
@@ -89,30 +92,30 @@ def smooth_wave(mesh: Mesh, met: jax.Array, wave: int = 0,
     fc = jnp.mean(fp, axis=2)                              # [T,4,3]
     farea = 0.5 * jnp.sqrt(jnp.sum(fn * fn, -1))           # [T,4]
     # all 12 (face, corner) contributions in ONE wide scatter:
-    # payload = (area-weighted normal[3], area*centroid[3], area[1])
+    # payload = (area-weighted normal[3], area*centroid[3], area[1],
+    #            unit normal[3], count[1]) — the unit-normal sum feeds
+    # the locally-flat gate below with no second full-width pass
     idx12 = jnp.concatenate(
         [jnp.where(isb[:, f], fv[:, f, k], capP)
          for f in range(4) for k in range(3)])
     w4 = jnp.where(isb, farea, 0.0)                        # [T,4]
+    fn_unit = fn / (jnp.linalg.norm(fn, axis=-1, keepdims=True) + EPSD)
     pay_f = jnp.concatenate(
-        [fn, w4[..., None] * fc, w4[..., None]], axis=-1)  # [T,4,7]
+        [fn, w4[..., None] * fc, w4[..., None], fn_unit,
+         jnp.ones_like(w4)[..., None]], axis=-1)           # [T,4,11]
     pay12 = jnp.concatenate(
         [pay_f[:, f] for f in range(4) for _ in range(3)])
-    sacc = jnp.zeros((capP + 1, 7), mesh.vert.dtype).at[idx12].add(
+    sacc = jnp.zeros((capP + 1, 11), mesh.vert.dtype).at[idx12].add(
         pay12, mode="drop")
     nacc, cacc, aacc = sacc[:, :3], sacc[:, 3:6], sacc[:, 6]
+    uacc, ucnt = sacc[:, 7:10], sacc[:, 10]
     navg = nacc[:capP] / (jnp.linalg.norm(nacc[:capP], axis=-1,
                                           keepdims=True) + EPSD)
-    # locally-flat gate: every incident boundary face within FLAT_COS of
-    # the average normal (second pass against the computed navg; again
-    # one concatenated scatter-min)
-    fn_unit = fn / (jnp.linalg.norm(fn, axis=-1, keepdims=True) + EPSD)
-    dot12 = jnp.concatenate(
-        [jnp.sum(fn_unit[:, f] * navg[jnp.clip(fv[:, f, k], 0, capP - 1)],
-                 -1) for f in range(4) for k in range(3)])
-    ndev = jnp.full((capP + 1,), jnp.inf, mesh.vert.dtype).at[idx12].min(
-        dot12, mode="drop")
-    flat = (ndev[:capP] >= FLAT_COS) & (aacc[:capP] > 0)
+    # locally-flat gate: |sum of unit normals| close to the face count
+    # means every incident boundary face is near the common plane
+    ratio = jnp.linalg.norm(uacc[:capP], axis=-1) / \
+        jnp.maximum(ucnt[:capP], 1.0)
+    flat = (ratio >= FLAT_RATIO) & (aacc[:capP] > 0)
     bdy_ok = reg_bdy & flat
     cbar = cacc[:capP] / jnp.maximum(aacc[:capP, None], EPSD)
     dvec = cbar - mesh.vert

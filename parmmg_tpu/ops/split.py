@@ -158,100 +158,122 @@ def split_wave(mesh: Mesh, met: jax.Array, lmax: float = LLONG,
                            jnp.zeros(capT, bool))
 
     def _act(_):
+        from .quality import quality_from_points
+        from ..core.constants import QUAL_FLOOR
+        from .edges import wave_budget
+        capE = et.ev.shape[0]
+        ar0 = jnp.arange(capT)
         s, t = claim_channels(lens, cand)                 # sort-free priority
 
         # --- nomination: each tet picks its (s,t)-max candidate edge ---------
-        tes = jnp.where(mesh.tmask[:, None], s[et.edge_id], NEG_INF)
+        # both channels ride ONE [capT,6,2] gather (t bitcast to f32 lanes)
+        st = jnp.stack([s, jax.lax.bitcast_convert_type(t, jnp.float32)],
+                       axis=1)                            # [capE,2]
+        st_te = st[et.edge_id]                            # [capT,6,2]
+        tes = jnp.where(mesh.tmask[:, None], st_te[..., 0], NEG_INF)
+        t_te = jax.lax.bitcast_convert_type(st_te[..., 1], jnp.int32)
         best_s = jnp.max(tes, axis=1)                     # [capT]
         at_best = (tes == best_s[:, None]) & jnp.isfinite(best_s)[:, None]
-        tet_t = jnp.where(at_best, t[et.edge_id], PRI_MIN)
+        tet_t = jnp.where(at_best, t_te, PRI_MIN)
         best_t = jnp.max(tet_t, axis=1)
         # exactly one slot per tet (t is unique): the whole-shell win test
         # below stays exact under simultaneous application
         nominate = at_best & (tet_t == best_t[:, None])
-
-        # degeneracy veto (MMG5_split1b cavity-quality check): a tet refuses
-        # its nominated edge if either child tet would be degenerate — thin
-        # tets halved at a midpoint can round to exactly zero volume in f32
-        from .quality import quality_from_points
-        from ..core.constants import QUAL_FLOOR
-        ar0 = jnp.arange(capT)
-        loc_n = jnp.argmax(nominate, axis=1)                  # [capT]
-        e_n = et.edge_id[ar0, loc_n]
-        i_n = _IARE_J[loc_n, 0]
-        j_n = _IARE_J[loc_n, 1]
-        mid_n = 0.5 * (mesh.vert[va[e_n]] + mesh.vert[vb[e_n]])
-        if lift_corr is not None:
-            mid_n = mid_n + lift_corr[e_n]
-        pts = mesh.vert[mesh.tet]                             # [T,4,3]
-        q1 = quality_from_points(pts.at[ar0, j_n].set(mid_n))
-        q2 = quality_from_points(pts.at[ar0, i_n].set(mid_n))
-        nominate = nominate & ((q1 > QUAL_FLOOR) & (q2 > QUAL_FLOOR))[:, None]
+        # nomination-time degeneracy prescreen: split children inherit
+        # >= half the parent quality (the midpoint halves the volume
+        # exactly and no child edge exceeds a parent edge), so only
+        # near-degenerate parents can produce sub-floor children.  Veto
+        # their nominations HERE so such shells never pin top-K budget
+        # slots wave after wave (starvation); the exact [KH] veto below
+        # stays as the precise guard (incl. hausd-lifted midpoints,
+        # where the half-quality bound is only approximate).
+        q_par = quality_from_points(mesh.vert[mesh.tet])
+        nominate = nominate & (q_par > 4.0 * QUAL_FLOOR)[:, None]
+        has_nom = jnp.any(nominate, axis=1)
+        loc_n = jnp.argmax(nominate, axis=1)              # [capT]
+        e_n = jnp.clip(et.edge_id[ar0, loc_n], 0, capE - 1)
 
         # --- an edge wins iff nominated by its whole shell -------------------
-        capE = et.ev.shape[0]
-        nom_count = jnp.zeros(capE, jnp.int32).at[et.edge_id.reshape(-1)].add(
-            nominate.reshape(-1).astype(jnp.int32))
-        win = cand & (nom_count == et.nshell) & (et.nshell > 0)
+        # each tet nominates at most ONE edge, so the count scatters at
+        # [capT] width (not [6*capT] — scatter cost is linear in index
+        # count, scripts/tpu_microbench.py)
+        nom_count = jnp.zeros(capE, jnp.int32).at[
+            jnp.where(has_nom, e_n, capE)].add(1, mode="drop")
+        win0 = cand & (nom_count == et.nshell) & (et.nshell > 0)
 
-        # --- allocate midpoint vertices --------------------------------------
-        win_i = win.astype(jnp.int32)
-        new_off = jnp.cumsum(win_i) - win_i               # prefix index per win
-        nwin = jnp.sum(win_i)
-        free_p = capP - mesh.npoin
-        # capacity guard: drop lowest-priority winners that don't fit
-        fits_p = new_off < free_p
-        # each winning edge adds nshell tets; prefix over shells
-        shell_add = jnp.where(win & fits_p, et.nshell, 0)
-        tet_off = jnp.cumsum(shell_add) - shell_add
-        free_t = capT - mesh.nelem
-        fits_t = (tet_off + shell_add) <= free_t
-        win_cap = win & fits_p & fits_t
-        # overflow = CAPACITY-dropped winners only (triggers a host regrow);
-        # the per-wave budget below just defers winners to the next wave
-        overflow = (nwin > 0) & (jnp.sum(win_cap) < nwin)
-        # per-wave budget: at most KW midpoints / KH shell tets per wave, so
-        # the apply scatters run at [KW]/[KH] width instead of [6*capT]/[capT]
-        # (scatter cost is linear in index count — scripts/wave_time.py).
-        # The cut is by PRIORITY (longest edges first), not slot order — a
-        # slot-order cut would refine the mesh spatially unevenly
-        from .edges import wave_budget
-        KW = min(wave_budget(capT, budget_div), et.ev.shape[0])
+        # --- budget: top-K winners by priority (longest edges first) ---------
+        # replaces a full-width argsort + 6 full-width cumsums with ONE
+        # top_k and [KW]-width prefix sums (scripts/split_stage_time.py:
+        # the budget/offset stage was ~30 ms of the wave)
+        KW = min(wave_budget(capT, budget_div), capE)
         KH = min(2 * wave_budget(capT, budget_div), capT)
-        bord = jnp.argsort(jnp.where(win_cap, -lens, jnp.inf))
-        win_srt = win_cap[bord]
-        off_srt = jnp.cumsum(win_srt.astype(jnp.int32)) - win_srt
-        sh_srt = jnp.where(win_srt & (off_srt < KW), et.nshell[bord], 0)
-        toff_srt = jnp.cumsum(sh_srt) - sh_srt
-        ok_srt = win_srt & (off_srt < KW) & ((toff_srt + sh_srt) <= KH)
-        win = jnp.zeros_like(win_cap).at[bord].set(ok_srt,
-                                                   unique_indices=True)
-        # recompute offsets over the final winner set
-        win_i = win.astype(jnp.int32)
-        new_off = jnp.cumsum(win_i) - win_i
-        shell_add = jnp.where(win, et.nshell, 0)
-        tet_off = jnp.cumsum(shell_add) - shell_add
-        nwin = jnp.sum(win_i)
+        vals, wc = jax.lax.top_k(jnp.where(win0, lens, -jnp.inf), KW)
+        wv = vals > NEG_INF                               # real winners
+        wcc = jnp.clip(wc, 0, capE - 1)
+        # the KH shell-tet budget must bound the winner set BEFORE the
+        # row compaction below — rows past the static compaction size
+        # would be silently dropped, splitting only part of a shell
+        sh0 = jnp.where(wv, et.nshell[wcc], 0)
+        toff0 = jnp.cumsum(sh0) - sh0
+        wv = wv & ((toff0 + sh0) <= KH)
 
-        capE = et.ev.shape[0]
-        mid_id = (mesh.npoin + new_off).astype(jnp.int32)  # [capE] vertex slot
-        # midpoint coordinates / refs / tags — computed on the COMPACTED
-        # winner set [KW] (budget above guarantees it fits)
-        widx = jnp.nonzero(win, size=KW, fill_value=capE)[0]
-        wv = widx < capE
-        wc = jnp.clip(widx, 0, capE - 1)
-        va_w, vb_w = va[wc], vb[wc]
+        # --- degeneracy veto (MMG5_split1b cavity-quality check) -------------
+        # evaluated on the [KH]-compacted shells of the budget winners
+        # instead of all capT tets: a shell tet whose child would be
+        # degenerate vetoes the whole edge (the wave simply skips it; the
+        # old nomination-time veto had the same final effect)
+        keep0 = jnp.zeros(capE, bool).at[jnp.where(wv, wc, capE)].set(
+            True, mode="drop", unique_indices=True)
+        has0 = has_nom & keep0[e_n]
+        hidx = jnp.nonzero(has0, size=KH, fill_value=capT)[0]
+        hv0 = hidx < capT
+        hc = jnp.clip(hidx, 0, capT - 1)
+        arK = jnp.arange(KH)
+        loc0 = loc_n[hc]
+        e0 = jnp.clip(e_n[hc], 0, capE - 1)
+        il = _IARE_J[loc0, 0]                             # [KH]
+        jl = _IARE_J[loc0, 1]
+        rows0 = mesh.tet[hc]                              # [KH,4]
+        mid_row = 0.5 * (mesh.vert[va[e0]] + mesh.vert[vb[e0]])
+        if lift_corr is not None:
+            mid_row = mid_row + lift_corr[e0]
+        pts0 = mesh.vert[rows0]                           # [KH,4,3]
+        q1 = quality_from_points(pts0.at[arK, jl].set(mid_row))
+        q2 = quality_from_points(pts0.at[arK, il].set(mid_row))
+        rowbad = hv0 & ~((q1 > QUAL_FLOOR) & (q2 > QUAL_FLOOR))
+        veto_e = jnp.zeros(capE + 1, bool).at[
+            jnp.where(rowbad, e0, capE)].max(rowbad, mode="drop")[:capE]
+
+        # --- final winner set + offsets, all at [KW] width -------------------
+        okv = wv & ~veto_e[wcc]
+        win_i = okv.astype(jnp.int32)
+        new_off = jnp.cumsum(win_i) - win_i
+        free_p = capP - mesh.npoin
+        fits_p = new_off < free_p
+        sh = jnp.where(okv & fits_p, et.nshell[wcc], 0)
+        toff = jnp.cumsum(sh) - sh
+        free_t = capT - mesh.nelem
+        fits_cap = fits_p & ((toff + sh) <= free_t)
+        ok = okv & fits_cap
+        # overflow = CAPACITY-dropped winners only (triggers a host
+        # regrow); budget- or veto-dropped winners just defer
+        overflow = jnp.any(okv & ~fits_cap)
+        nwin = jnp.sum(ok.astype(jnp.int32))
+
+        # midpoint coordinates / refs / tags on the [KW] winner rows
+        va_w, vb_w = va[wcc], vb[wcc]
         pa, pb = mesh.vert[va_w], mesh.vert[vb_w]
         mid = 0.5 * (pa + pb)
         if lift_corr is not None:
-            mid = mid + lift_corr[wc]             # onto the Bezier surface
-        tgt_w = jnp.where(wv, mid_id[wc], capP)
+            mid = mid + lift_corr[wcc]            # onto the Bezier surface
+        mid_id_w = (mesh.npoin + new_off).astype(jnp.int32)
+        tgt_w = jnp.where(ok, mid_id_w, capP)
         vert = mesh.vert.at[tgt_w].set(mid, mode="drop", unique_indices=True)
         vmask = mesh.vmask.at[tgt_w].set(True, mode="drop",
                                          unique_indices=True)
         # the new point inherits the edge's tags (a point on a ridge edge is a
         # ridge point, on a boundary edge a boundary point, ...)
-        vtag = mesh.vtag.at[tgt_w].set(et.etag[wc], mode="drop",
+        vtag = mesh.vtag.at[tgt_w].set(et.etag[wcc], mode="drop",
                                        unique_indices=True)
         vref = mesh.vref.at[tgt_w].set(
             jnp.minimum(mesh.vref[va_w], mesh.vref[vb_w]), mode="drop",
@@ -259,34 +281,26 @@ def split_wave(mesh: Mesh, met: jax.Array, lmax: float = LLONG,
         met_new = met.at[tgt_w].set(_interp_met_mid(met, va_w, vb_w),
                                     mode="drop", unique_indices=True)
 
-        # --- split shell tets (compacted to the [KH] affected rows) -----------
-        # per (tet, local edge): is my edge winning, and bookkeeping
-        e_win = win[et.edge_id] & mesh.tmask[:, None]          # [capT,6]
-        # at most one winning edge per tet (guaranteed); its local index:
-        loc_e = jnp.argmax(e_win, axis=1)                      # [capT]
-        has = jnp.any(e_win, axis=1)
-        eid = et.edge_id[jnp.arange(capT), loc_e]              # unique edge id
-        m_id = jnp.clip(mid_id[eid], 0, capP - 1)              # midpoint vid
+        # --- allocation tables: midpoint vid + tet-slot base per edge --------
+        # ONE packed [KW] scatter; -1 marks non-winning edges
+        alloc = jnp.full((capE, 2), -1, jnp.int32).at[
+            jnp.where(ok, wc, capE)].set(
+            jnp.stack([mid_id_w,
+                       (mesh.nelem + toff).astype(jnp.int32)], axis=1),
+            mode="drop", unique_indices=True)
 
-        # rank of this tet within its shell -> new tet slot.  A winning edge is
-        # nominated by its WHOLE shell, so the shell tets of a winning edge are
-        # exactly the tets whose chosen slot maps to it — the shell rank
-        # precomputed by unique_edges (sorted-segment rank, ascending tet id)
-        # is that rank, no extra sort needed.
-        shell_rank = et.shell_rank[jnp.arange(capT), loc_e]
-        new_tid = (mesh.nelem + tet_off[eid] + shell_rank).astype(jnp.int32)
-
-        # compacted affected-tet rows (budget KH guaranteed above)
-        hidx = jnp.nonzero(has, size=KH, fill_value=capT)[0]
-        hv = hidx < capT
-        hc = jnp.clip(hidx, 0, capT - 1)
-        arK = jnp.arange(KH)
-        il = _IARE_J[loc_e[hc], 0]                             # [KH]
-        jl = _IARE_J[loc_e[hc], 1]
-        mh = m_id[hc]
-        tgt1 = jnp.where(hv, hidx, capT)
-        tgt2 = jnp.where(hv, new_tid[hc], capT)
-        rows0 = mesh.tet[hc]                                   # [KH,4]
+        # --- split shell tets on the same [KH] compaction --------------------
+        # shell tets of a winning edge are exactly the tets that nominated
+        # it (whole-shell rule), so the pre-veto compaction rows are reused
+        # with an updated validity mask — no second nonzero pass
+        al_row = alloc[e0]                                # [KH,2]
+        hv = hv0 & (al_row[:, 0] >= 0)
+        mh = jnp.clip(al_row[:, 0], 0, capP - 1)
+        # rank of this tet within its shell -> new tet slot (the shell
+        # rank precomputed by unique_edges: sorted-segment rank)
+        new_tid_r = al_row[:, 1] + et.shell_rank[hc, loc0]
+        tgt1 = jnp.where(hv, hc, capT)
+        tgt2 = jnp.where(hv, jnp.clip(new_tid_r, 0, capT - 1), capT)
         # tet1 (in place): vertex j -> m ; tet2 (new slot): vertex i -> m
         tet1_rows = rows0.at[arK, jl].set(mh, unique_indices=True)
         tet2_rows = rows0.at[arK, il].set(mh, unique_indices=True)
@@ -317,15 +331,17 @@ def split_wave(mesh: Mesh, met: jax.Array, lmax: float = LLONG,
                                          unique_indices=True)
 
         npoin = mesh.npoin + nwin
-        nelem = mesh.nelem + jnp.sum(jnp.where(has, 1, 0), dtype=jnp.int32)
+        nelem = mesh.nelem + jnp.sum(hv, dtype=jnp.int32)
         out = dataclasses.replace(
             mesh, vert=vert, vmask=vmask, vtag=vtag, vref=vref,
             tet=tet_out, tmask=tmask, tref=tref,
             ftag=ftag, fref=frf, etag=etag_out,
             npoin=npoin.astype(jnp.int32), nelem=nelem.astype(jnp.int32))
-        # tets rewritten in place (has) or created (tgt2 slots) this wave —
-        # the staleness footprint for a collapse sharing our edge table
-        modified = has.at[tgt2].set(True, mode="drop", unique_indices=True)
+        # tets rewritten in place (tgt1) or created (tgt2) this wave — the
+        # staleness footprint for a collapse sharing our edge table
+        modified = jnp.zeros(capT, bool).at[tgt1].set(
+            True, mode="drop", unique_indices=True).at[tgt2].set(
+            True, mode="drop", unique_indices=True)
         return SplitResult(out, met_new, nwin, overflow, modified)
 
     return jax.lax.cond(jnp.any(cand), _act, _idle, None)

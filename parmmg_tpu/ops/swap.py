@@ -123,7 +123,8 @@ def swap_edges_wave(mesh: Mesh, met: jax.Array, enable32: bool = True,
     pre = pre32 | pre22
     from .edges import wave_budget
     K = min(Efull, wave_budget(capT, budget_div))
-    sel = jnp.argsort(jnp.where(pre, q_shell, jnp.inf))[:K]
+    # top-K worst shells without a full-width argsort
+    _, sel = jax.lax.top_k(jnp.where(pre, -q_shell, -jnp.inf), K)
 
     # ---- compacted columns ----------------------------------------------
     ev_c = et.ev[sel]
@@ -264,9 +265,14 @@ def swap_edges_wave(mesh: Mesh, met: jax.Array, enable32: bool = True,
         kmax = jnp.maximum(x0, x1)
         if capP <= PACK_LIMIT:
             i32max = jnp.iinfo(jnp.int32).max
-            ekey = jnp.where(et.emask, et.ev[:, 0] * capP + et.ev[:, 1],
-                             i32max)
-            ekey = jnp.sort(ekey)             # full table, [6*capT]
+            # the table's internal sort already produced ascending packed
+            # keys (duplicates included — harmless for the existence
+            # probe); reuse them instead of re-sorting [6*capT] keys
+            if et.skey.shape[0] == Efull:
+                ekey = et.skey
+            else:
+                ekey = jnp.sort(jnp.where(
+                    et.emask, et.ev[:, 0] * capP + et.ev[:, 1], i32max))
             pkey = kmin * capP + kmax
             loc = jnp.searchsorted(ekey, pkey)
             exists = ekey[jnp.clip(loc, 0, Efull - 1)] == pkey
@@ -484,7 +490,7 @@ def swap23_wave(mesh: Mesh, met: jax.Array,
                                           jnp.inf))
     from .edges import wave_budget
     F = min(capT, wave_budget(capT, budget_div))
-    sel = jnp.argsort(jnp.where(cand_full, q_pair, jnp.inf))[:F]
+    _, sel = jax.lax.top_k(jnp.where(cand_full, -q_pair, -jnp.inf), F)
     ar = jnp.arange(F)
     t1 = sel.astype(jnp.int32)
     f1 = fstar[sel]

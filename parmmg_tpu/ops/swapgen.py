@@ -77,7 +77,7 @@ def swapgen_wave(mesh: Mesh, met: jax.Array,
     # can therefore pin budget slots; this kernel runs in the
     # wide-budget polish phase where K covers the population.
     K = min(Efull, wave_budget(capT, budget_div))
-    selx = jnp.argsort(jnp.where(pre, q_shell_f, jnp.inf))[:K]
+    _, selx = jax.lax.top_k(jnp.where(pre, -q_shell_f, -jnp.inf), K)
 
     ar = jnp.arange(K)
     cand = pre[selx]
