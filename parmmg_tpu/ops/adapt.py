@@ -61,7 +61,8 @@ def adapt_cycle_impl(mesh: Mesh, met: jax.Array, wave: jax.Array,
                      smooth_waves: int = 1, do_insert: bool = True,
                      final_rebuild: bool = True,
                      hausd: float | None = None,
-                     budget_div: int = 8):
+                     budget_div: int = 8,
+                     et0=None):
     """One adaptation cycle: split -> collapse -> [swap] -> [smooth].
 
     Pure jittable function (jitted wrapper below) — also the compile-check
@@ -90,8 +91,13 @@ def adapt_cycle_impl(mesh: Mesh, met: jax.Array, wave: jax.Array,
         # candidates whose table rows the split made stale
         from .edges import unique_edges, edge_lengths
         # slim table: split/collapse never read shell3 (only the swap
-        # kernels, which build their own) — skips a [6*capT] scatter
-        et0 = unique_edges(mesh, shell_slots=0)
+        # kernels, which build their own) — skips a [6*capT] scatter.
+        # ``et0``: a caller-provided table of THIS mesh (the fused block
+        # reuses the previous cycle's table after a topology-quiet
+        # cycle — smoothing only moves vertices, so the table is
+        # provably identical; metric lengths ALWAYS recompute).
+        if et0 is None:
+            et0 = unique_edges(mesh, shell_slots=0)
         lens0 = edge_lengths(mesh, et0, met)
         # ridge tangents once per cycle too (same sharing rationale;
         # collapse only consults non-stale candidates, whose tangent
@@ -205,13 +211,36 @@ def adapt_cycles_fused_impl(mesh: Mesh, met: jax.Array, wave0: jax.Array,
             (c + swap_offset) % swap_every == swap_every - 1
             for c in range(n_cycles))
     counts_all = []
+    # edge-table cache across the block: after a cycle with zero
+    # topological changes (splits/collapses/swaps), the next cycle's
+    # table rebuild is lax.cond-skipped — at steady state (smoothing
+    # churn only) this removes the largest remaining per-cycle item
+    from .edges import unique_edges
+    prev_et = None
+    prev_ok = None
     for c, dosw in enumerate(swap_flags):
+        et_c = None
+        if do_insert:
+            if prev_et is None:
+                et_c = unique_edges(mesh, shell_slots=0)
+            else:
+                pe = prev_et
+
+                def _reuse(_, pe=pe):
+                    return pe
+
+                def _rebuild(_, m=mesh):
+                    return unique_edges(m, shell_slots=0)
+                et_c = jax.lax.cond(prev_ok, _reuse, _rebuild, None)
         mesh, met, counts = adapt_cycle_impl(
             mesh, met, wave0 + c, do_swap=dosw,
             do_smooth=do_smooth, do_insert=do_insert,
             final_rebuild=(c == len(swap_flags) - 1), hausd=hausd,
-            budget_div=budget_div)
+            budget_div=budget_div, et0=et_c)
         counts_all.append(counts)
+        if do_insert:
+            prev_et = et_c
+            prev_ok = (counts[0] + counts[1] + counts[2]) == 0
     return mesh, met, jnp.stack(counts_all)
 
 

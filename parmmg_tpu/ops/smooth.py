@@ -188,7 +188,14 @@ def smooth_wave(mesh: Mesh, met: jax.Array, wave: int = 0,
         take = ok & (gain > best_gain)
         newpos = jnp.where(take[:, None], cand_pos, newpos)
         best_gain = jnp.where(take, gain, best_gain)
-    improves = best_gain > 0
+    # minimum-gain gate (Mmg's movers demand a real improvement too):
+    # balls already above the sliver threshold only move for a >=2%
+    # relative lift of their min quality — without this, centroid
+    # micro-moves churn forever at steady state (each move re-creates
+    # short edges for the collapse pass), so a converged mesh never
+    # reaches the cheap idle cycles; bad balls keep the any-gain rule
+    gain_tol = jnp.where(minq_old < 0.2, 0.0, 0.02 * minq_old)
+    improves = best_gain > gain_tol
 
     # --- independent set: vertex claims its ball tets --------------------
     # wave-rotated hash: a full-avalanche BIJECTIVE mix (odd multiplies +

@@ -637,67 +637,182 @@ def distributed_adapt_multi(mesh: Mesh, met, n_shards: int,
             glo[s_] = np.concatenate(
                 [glo[s_], np.full(old_capP, -1, np.int64)])
 
+    # ---- O(band) device path state --------------------------------------
+    # the band path keeps the numbering ON DEVICE (int32 lockstep copy)
+    # and replaces the full views pull + host interface rescan with
+    # device-compacted band/interface tables (parallel/migrate_dev.py);
+    # any budget overflow falls back to the full-view oracle path below
+    import os as _os
+    use_band = (mode != "graph"
+                and _os.environ.get("PARMMG_BAND_PATH", "1") != "0")
+    glo_d = None
+    shared_prev = None
+    if use_band:
+        from .migrate_dev import (extend_ids_device, band_migrate_iteration,
+                                  band_weld)
+        glo_d = jnp.asarray(np.stack(glo).astype(np.int32))
+        # initially-shared gids: interface vertices of the initial comms
+        shared_prev = _shared_gids(comms, glo, n_shards)
+
     regrow_state = [0]
     ana_cache: dict = {}
     for it in range(max(1, niter)):
+        capP_before = stacked.vert.shape[1]
         stacked, met_s = run_adapt_cycles(
             stacked, met_s, steps, cycles, dmesh,
             stats=stats, verbose=verbose, on_grow=grow_glo,
             regrow_state=regrow_state, label=f"dist it {it}",
             noswap=noswap)
-        # extend the session numbering from a vmask-only pull (tiny),
-        # run the DEVICE analysis refresh, THEN pull the consolidated
-        # views — the single big pull carries the refreshed tags, so no
-        # host-numpy analysis and no tag re-push are needed
-        vmask_h = np.asarray(stacked.vmask)
-        top = extend_global_ids_from_vmask(glo, vmask_h, top)
+        if use_band and stacked.vert.shape[1] != capP_before:
+            glo_d = None          # regrown: rebuild the device copy
+        # extend the session numbering (device on the band path, with a
+        # band-sized fresh-id pull; vmask-pull host path otherwise),
+        # then the DEVICE analysis refresh
+        if use_band:
+            if glo_d is None:
+                glo_d = jnp.asarray(np.stack(glo).astype(np.int32))
+            KN = max(256, stacked.vert.shape[1] // 2)
+            # int32 numbering on device (documented migrate_dev limit)
+            glo_d2, top_d, f_rows, f_gids, oke = extend_ids_device(
+                glo_d, stacked.vmask, jnp.asarray(top, jnp.int32),
+                KN=KN)
+            if bool(oke):
+                glo_d = glo_d2
+                top = int(top_d)
+                f_rows = np.asarray(f_rows)
+                f_gids = np.asarray(f_gids)
+                vmask_h = np.asarray(stacked.vmask)
+                for s_ in range(n_shards):
+                    m = f_rows[s_] >= 0
+                    glo[s_][f_rows[s_][m]] = f_gids[s_][m]
+                    glo[s_][~vmask_h[s_]] = -1
+            else:               # fresh-id budget blown: host extend
+                vmask_h = np.asarray(stacked.vmask)
+                top = extend_global_ids_from_vmask(glo, vmask_h, top)
+                glo_d = jnp.asarray(np.stack(glo).astype(np.int32))
+        else:
+            vmask_h = np.asarray(stacked.vmask)
+            top = extend_global_ids_from_vmask(glo, vmask_h, top)
         st2 = refresh_shard_analysis_device(
             stacked, comms, n_shards, ang, glo, dmesh, cache=ana_cache)
+        views = None
         if st2 is not None:
             stacked = st2
-            views = pull_views(stacked, met_s)
         else:
             # host fallback (shared-record budget overflow)
             views = pull_views(stacked, met_s)
             stacked = refresh_shard_analysis(
                 stacked, comms, n_shards, ang, glo=glo, views=views)
         if it + 1 < max(1, niter) and not nobalancing:
-            if mode == "graph":
-                labels = graph_repartition_labels(views, glo, n_shards)
-                labels = enforce_ne_min(labels, views.tmask, n_shards)
-            else:
-                sizes = jnp.asarray(
-                    views.tmask.sum(axis=1).astype(np.int32))
+            nmoved = 0
+            band_done = False
+            if use_band:
+                sizes = jnp.sum(stacked.tmask, axis=1, dtype=jnp.int32)
                 labels_d, depth_d = flood_labels(
                     stacked, jnp.asarray(comms.node_idx),
                     jnp.asarray(comms.nbr), sizes, n_shards,
                     nlayers=ifc_layers)
-                labels = np.asarray(labels_d)
-                labels = enforce_ne_min(labels, views.tmask, n_shards,
-                                        depth=np.asarray(depth_d))
-            # destination shards (band recipients) — computed BEFORE the
-            # migration mutates the views/labels shapes
-            touched = sorted({int(r) for s_ in range(n_shards)
-                              for r in np.unique(
-                                  labels[s_][views.tmask[s_]])
-                              if int(r) != s_})
-            stacked, met_s, comms2, nmoved = migrate_shards(
-                stacked, met_s, views, glo, labels, n_shards,
-                verbose=verbose)
-            if nmoved:
-                comms = comms2
-                # weld near-duplicate pairs now interior to one shard
-                # (the merged path got this from merge_shards every
-                # iteration; see migrate.weld_shard_bands)
-                stacked, _ = weld_shard_bands(
-                    stacked, views, glo, n_shards,
-                    touched=touched, verbose=verbose)
-                stacked = rebuild_shards(stacked)
-                check_interface_echo(stacked, met_s, comms, dmesh,
-                                     vert_h)
-                if verbose >= 2:
-                    print(f"  it {it}: migrated {nmoved} interface-band "
-                          "tets")
+                res = band_migrate_iteration(
+                    stacked, met_s, glo_d, glo, labels_d, depth_d,
+                    shared_prev, n_shards, verbose=verbose)
+                # capacity/budget overflow: slot-stable grow (the full
+                # path's migrate_shards grow loop analogue) raises both
+                # the free slots AND the capacity-scaled band budgets;
+                # bounded retries before the full-view fallback
+                for _retry in range(3):
+                    if res is not None:
+                        break
+                    from .distribute import grow_shards
+                    capP_o = stacked.vert.shape[1]
+                    capT_o = stacked.tet.shape[1]
+                    stacked, met_s = grow_shards(
+                        stacked, met_s, 2 * capP_o, 2 * capT_o)
+                    views = None    # any pre-grow pull is shape-stale
+                    grow_glo(capP_o)
+                    glo_d = jnp.asarray(np.stack(glo).astype(np.int32))
+                    me_col = jnp.arange(n_shards,
+                                        dtype=labels_d.dtype)[:, None]
+                    labels_d = jnp.concatenate(
+                        [labels_d, jnp.broadcast_to(
+                            me_col, (n_shards, capT_o))], axis=1)
+                    depth_d = jnp.concatenate(
+                        [depth_d, jnp.zeros((n_shards, capT_o),
+                                            depth_d.dtype)], axis=1)
+                    res = band_migrate_iteration(
+                        stacked, met_s, glo_d, glo, labels_d, depth_d,
+                        shared_prev, n_shards, verbose=verbose)
+                if res is not None:
+                    (stacked, met_s, glo_d, comms2, shared_prev,
+                     nmoved, arr_slots) = res
+                    band_done = True
+                    if nmoved:
+                        comms = comms2
+                        # weld the arrival neighborhoods (region-scoped)
+                        stacked, nweld = band_weld(
+                            stacked, met_s, glo_d, glo, arr_slots,
+                            n_shards, verbose=verbose)
+                        if nweld < 0:     # region budget blown: full weld
+                            views_w = pull_views(stacked, met_s)
+                            stacked, _ = weld_shard_bands(
+                                stacked, views_w, glo, n_shards,
+                                verbose=verbose)
+                        stacked = rebuild_shards(stacked)
+                        check_interface_echo(stacked, met_s, comms,
+                                             dmesh, vert_h)
+                elif verbose >= 1:
+                    print(f"  it {it}: band budgets exceeded — "
+                          "falling back to the full-view path")
+            if not band_done:
+                if views is None:
+                    views = pull_views(stacked, met_s)
+                if mode == "graph":
+                    labels = graph_repartition_labels(views, glo,
+                                                      n_shards)
+                    labels = enforce_ne_min(labels, views.tmask,
+                                            n_shards)
+                else:
+                    sizes = jnp.asarray(
+                        views.tmask.sum(axis=1).astype(np.int32))
+                    labels_d, depth_d = flood_labels(
+                        stacked, jnp.asarray(comms.node_idx),
+                        jnp.asarray(comms.nbr), sizes, n_shards,
+                        nlayers=ifc_layers)
+                    labels = np.asarray(labels_d)
+                    labels = enforce_ne_min(labels, views.tmask,
+                                            n_shards,
+                                            depth=np.asarray(depth_d))
+                touched = sorted({int(r) for s_ in range(n_shards)
+                                  for r in np.unique(
+                                      labels[s_][views.tmask[s_]])
+                                  if int(r) != s_})
+                stacked, met_s, comms2, nmoved = migrate_shards(
+                    stacked, met_s, views, glo, labels, n_shards,
+                    verbose=verbose)
+                if nmoved:
+                    comms = comms2
+                    stacked, _ = weld_shard_bands(
+                        stacked, views, glo, n_shards,
+                        touched=touched, verbose=verbose)
+                    stacked = rebuild_shards(stacked)
+                    check_interface_echo(stacked, met_s, comms, dmesh,
+                                         vert_h)
+                if use_band:    # resync the device numbering copy
+                    glo_d = jnp.asarray(np.stack(glo).astype(np.int32))
+                    shared_prev = _shared_gids(comms, glo, n_shards)
+            if nmoved and verbose >= 2:
+                print(f"  it {it}: migrated {nmoved} interface-band "
+                      "tets")
     merged, met_m, part_new = merge_shards(stacked, met_s,
                                            return_part=True)
     return merged, met_m, part_new
+
+
+def _shared_gids(comms, glo, n_shards: int) -> np.ndarray:
+    """Interface-vertex gids from the comm tables (the band path's
+    shared-vertex candidate seed)."""
+    sh0 = []
+    for s_ in range(n_shards):
+        rows = np.unique(comms.node_idx[s_][comms.node_idx[s_] >= 0])
+        sh0.append(glo[s_][rows])
+    return np.unique(np.concatenate(sh0)) if sh0 else \
+        np.zeros(0, np.int64)

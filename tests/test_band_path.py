@@ -1,0 +1,180 @@
+"""O(band) device-resident migration path (parallel/migrate_dev.py).
+
+The reference touches only moving groups and OLDPARBDY entities between
+iterations (distributegrps_pmmg.c:1631-1841, analys_pmmg.c:1571); the
+band path must reproduce the full-view path's results while keeping the
+host work band/interface-sized.  The full-view path (parallel/migrate.py)
+is the oracle here.
+"""
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from parmmg_tpu.core.mesh import make_mesh, tet_volumes, mesh_to_host
+from parmmg_tpu.core import constants as C
+from parmmg_tpu.ops.adjacency import build_adjacency, check_adjacency
+from parmmg_tpu.ops.analysis import analyze_mesh
+from parmmg_tpu.ops.quality import tet_quality
+from parmmg_tpu.parallel import dist, migrate
+from parmmg_tpu.parallel.distribute import split_to_shards
+from parmmg_tpu.parallel.comms import build_interface_comms
+from parmmg_tpu.utils.fixtures import cube_mesh
+
+
+def _two_shards(n=2, capmul=4):
+    vert, tet = cube_mesh(n)
+    m = make_mesh(vert, tet, capP=capmul * len(vert),
+                  capT=capmul * len(tet))
+    m = analyze_mesh(m).mesh
+    met = jnp.full(m.capP, 0.5, m.vert.dtype)
+    vert_h, tet_h, _, _, _ = mesh_to_host(m)
+    cent = vert_h[tet_h].mean(axis=1)
+    part = (cent[:, 0] > 0.5).astype(np.int32)
+    s, ms, l2g = split_to_shards(m, met, part, 2, cap_mult=3.0,
+                                 return_l2g=True)
+    g2l = []
+    for s_ in range(2):
+        mm = np.full(len(vert_h), -1, np.int64)
+        mm[l2g[s_]] = np.arange(len(l2g[s_]))
+        g2l.append(mm)
+    comms = build_interface_comms(tet_h, part, 2, l2g, g2l)
+    capP = s.vert.shape[1]
+    glo = [np.full(capP, -1, np.int64) for _ in range(2)]
+    for s_ in range(2):
+        glo[s_][: len(l2g[s_])] = l2g[s_]
+    return s, ms, glo, comms, len(vert_h)
+
+
+def _tet_key_sets(stacked, glo, S):
+    """Per-shard frozenset of sorted global tet keys (slot-order free)."""
+    tm = np.asarray(stacked.tmask)
+    tet = np.asarray(stacked.tet)
+    out = []
+    for s in range(S):
+        rows = tet[s][tm[s]]
+        keys = np.sort(glo[s][rows], axis=1)
+        out.append({tuple(k) for k in keys})
+    return out
+
+
+def test_device_migrate_matches_host_oracle():
+    """Moving a hand-picked band through the device path must yield the
+    same per-shard tet sets, liveness, and interface tables as the
+    full-view host path."""
+    from parmmg_tpu.parallel.migrate_dev import band_migrate_iteration
+
+    # --- device path ------------------------------------------------------
+    s_d, ms_d, glo_d_host, comms, nv = _two_shards()
+    capT = s_d.tet.shape[1]
+    tm0 = np.asarray(s_d.tmask)
+    # move the first 3 live tets of shard 0 to shard 1
+    mv = np.where(tm0[0])[0][:3]
+    labels = np.tile(np.arange(2, dtype=np.int32)[:, None], (1, capT))
+    labels[0, mv] = 1
+    depth = np.zeros((2, capT), np.int32)
+    glo_dev = jnp.asarray(np.stack(glo_d_host).astype(np.int32))
+    shared_prev = np.unique(np.concatenate(
+        [glo_d_host[s][np.unique(
+            comms.node_idx[s][comms.node_idx[s] >= 0])]
+         for s in range(2)]))
+    glo_dev_mirror = [g.copy() for g in glo_d_host]
+    res = band_migrate_iteration(
+        s_d, ms_d, glo_dev, glo_dev_mirror, jnp.asarray(labels),
+        jnp.asarray(depth), shared_prev, 2)
+    assert res is not None, "band budgets must hold on this fixture"
+    out_d, met_d, glo_dev2, comms_d, shared_now, nmoved_d, arr = res
+    assert nmoved_d == 3
+
+    # --- host oracle ------------------------------------------------------
+    s_h, ms_h, glo_h, comms0, _ = _two_shards()
+    views = migrate.pull_views(s_h, ms_h)
+    out_h, met_h, comms_h, nmoved_h = migrate.migrate_shards(
+        s_h, ms_h, views, glo_h, labels, 2)
+    assert nmoved_h == 3
+
+    # --- parity -----------------------------------------------------------
+    keys_d = _tet_key_sets(out_d, glo_dev_mirror, 2)
+    keys_h = _tet_key_sets(out_h, glo_h, 2)
+    assert keys_d == keys_h
+    # device glo copy in lockstep with its host mirror (where live)
+    g2 = np.asarray(glo_dev2)
+    vm = np.asarray(out_d.vmask)
+    for s in range(2):
+        assert (g2[s][vm[s]] == glo_dev_mirror[s][vm[s]]).all()
+        assert (glo_dev_mirror[s][~vm[s]] == -1).all()
+    # same interface size (by construction of the same final partition)
+    nd = int((comms_d.face_idx >= 0).sum())
+    nh = int((comms_h.face_idx >= 0).sum())
+    assert nd == nh
+    # frozen faces agree as GLOBAL key sets
+    def frozen_faces(stacked, glo):
+        tm = np.asarray(stacked.tmask)
+        ftag = np.asarray(stacked.ftag)
+        tet = np.asarray(stacked.tet)
+        out = set()
+        for s in range(2):
+            r, c = np.where(((ftag[s] & C.MG_PARBDY) != 0)
+                            & tm[s][:, None])
+            tri = np.sort(glo[s][tet[s][r]][
+                np.arange(len(r))[:, None], C.IDIR[c]], axis=1)
+            out |= {tuple(k) for k in tri}
+        return out
+    assert frozen_faces(out_d, glo_dev_mirror) == \
+        frozen_faces(out_h, glo_h)
+
+
+def test_band_path_engages_no_full_pull():
+    """The default ifc loop must run without a single full views pull
+    (the O(mesh) host transfer the band path exists to remove)."""
+    calls = {"n": 0}
+    orig = migrate.pull_views
+
+    def counting(*a, **k):
+        calls["n"] += 1
+        return orig(*a, **k)
+
+    migrate.pull_views = counting
+    try:
+        vert, tet = cube_mesh(3)
+        m = make_mesh(vert, tet, capP=4 * len(vert), capT=4 * len(tet))
+        m = analyze_mesh(m).mesh
+        met = jnp.full(m.capP, 0.3, m.vert.dtype)
+        out, met2, part = dist.distributed_adapt_multi(
+            m, met, 4, niter=2, cycles=3)
+    finally:
+        migrate.pull_views = orig
+    assert calls["n"] == 0, \
+        "band path must not pull full shard views between iterations"
+    out = build_adjacency(out)
+    assert check_adjacency(out) == {"asymmetric": 0, "face_mismatch": 0}
+    vols = np.asarray(tet_volumes(out))[np.asarray(out.tmask)]
+    assert (vols > 0).all()
+    assert np.isclose(vols.sum(), 1.0, rtol=1e-4)
+    q = np.asarray(tet_quality(out, met2))[np.asarray(out.tmask)]
+    assert q.min() > 0.02
+
+
+def test_band_and_full_paths_agree_statistically():
+    """Same run with the band path forced OFF: both paths must deliver a
+    conforming unit cube of comparable size and quality (tie-order
+    differences make bitwise equality too strict)."""
+    import os
+    results = {}
+    for flag in ("1", "0"):
+        os.environ["PARMMG_BAND_PATH"] = flag
+        try:
+            vert, tet = cube_mesh(2)
+            m = make_mesh(vert, tet, capP=6 * len(vert),
+                          capT=6 * len(tet))
+            m = analyze_mesh(m).mesh
+            met = jnp.full(m.capP, 0.4, m.vert.dtype)
+            out, met2, part = dist.distributed_adapt_multi(
+                m, met, 2, niter=2, cycles=2)
+        finally:
+            os.environ.pop("PARMMG_BAND_PATH", None)
+        vols = np.asarray(tet_volumes(out))[np.asarray(out.tmask)]
+        assert (vols > 0).all()
+        assert np.isclose(vols.sum(), 1.0, rtol=1e-4)
+        results[flag] = int(np.asarray(out.tmask).sum())
+    a, b = results["1"], results["0"]
+    assert abs(a - b) <= 0.3 * max(a, b)
