@@ -581,6 +581,8 @@ class ParMesh:
         # invalidate all output caches
         self._glonum = None
         self._out_vn = None
+        self._out_ridge_nn = None
+        self._out_vtag_cache = None
         self._out_host_cache = None
         self._out_edges_cache = None
         self._out_tria_cache = None
@@ -761,8 +763,35 @@ class ParMesh:
         return self._out_vn
 
     def get_normal_at_vertex(self, pos: int):
+        """(nx, ny, nz) at output vertex ``pos`` (1-based).
+
+        At RIDGE points the averaged normal is geometrically meaningless
+        (the reference keeps two per-side normals in the xPoint,
+        analys_pmmg.c:199-1171, and exposes n1); here likewise the
+        first-side normal is returned — use
+        :meth:`get_ridge_normals_at_vertex` for both sides."""
+        from ..core.constants import MG_GEO, MG_REF, MG_CRN, MG_NOM
+        if getattr(self, "_out_vtag_cache", None) is None:
+            self._out_vtag_cache = np.asarray(self._out.vtag)[
+                np.asarray(self._out.vmask)]
+        t = int(self._out_vtag_cache[pos - 1])
+        if (t & (MG_GEO | MG_REF)) and not (t & (MG_CRN | MG_NOM)):
+            n1, _ = self.get_ridge_normals_at_vertex(pos)
+            return n1
         n = self.get_normals()[pos - 1]
         return float(n[0]), float(n[1]), float(n[2])
+
+    def get_ridge_normals_at_vertex(self, pos: int):
+        """Both per-side normals (n1, n2) at a ridge vertex (the xPoint
+        n1/n2 of the reference); zeros at non-ridge points."""
+        if getattr(self, "_out_ridge_nn", None) is None:
+            from ..ops.analysis import ridge_vertex_normals
+            n1, n2 = ridge_vertex_normals(self._out)
+            vm = np.asarray(self._out.vmask)
+            self._out_ridge_nn = (np.asarray(n1)[vm], np.asarray(n2)[vm])
+        n1, n2 = self._out_ridge_nn
+        return (tuple(float(x) for x in n1[pos - 1]),
+                tuple(float(x) for x in n2[pos - 1]))
 
     def get_scalar_met(self, pos: int) -> float:
         return float(self.get_metric()[pos - 1])

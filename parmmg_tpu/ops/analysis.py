@@ -73,6 +73,74 @@ def boundary_vertex_normals(mesh: Mesh) -> jax.Array:
     return nacc / (jnp.linalg.norm(nacc, axis=-1, keepdims=True) + EPSD)
 
 
+def ridge_vertex_normals(mesh: Mesh):
+    """Per-side normals (n1, n2) at ridge/reference-line vertices.
+
+    The reference stores TWO normals per ridge point (xPoint n1/n2,
+    routed by the hashNorver face coloring, analys_pmmg.c:199-1171 —
+    faces connected without crossing the ridge share a slot).  Batched
+    equivalent: per ridge vertex, the incident boundary faces are
+    2-clustered by normal direction — side 1 is seeded by the largest
+    incident face (two-channel scatter-max), side 2 is everything
+    deviating from that seed by more than ~half the ridge angle.  Exact
+    for the ubiquitous two-smooth-patch ridge; a connectivity coloring
+    (the reference's) differs only on pathological multi-patch points,
+    which classify MG_NOM/corner and are excluded anyway.
+
+    Returns (n1 [capP,3], n2 [capP,3]) unit normals; zeros off-ridge.
+    """
+    import jax.numpy as jnp
+    from ..core.constants import (IDIR, MG_BDY, MG_PARBDY, MG_GEO,
+                                  MG_REF, MG_CRN, MG_NOM, EPSD)
+    from .edges import PRI_MIN, tie_hash
+    capP = mesh.capP
+    idir = jnp.asarray(IDIR)
+    is_ridge_v = mesh.vmask & ((mesh.vtag & (MG_GEO | MG_REF)) != 0) & \
+        ((mesh.vtag & (MG_CRN | MG_NOM)) == 0)
+    isb = ((mesh.ftag & MG_BDY) != 0) & ((mesh.ftag & MG_PARBDY) == 0) & \
+        mesh.tmask[:, None]
+    fv = mesh.tet[:, idir]                                  # [T,4,3]
+    fp = mesh.vert[fv]
+    fn = jnp.cross(fp[:, :, 1] - fp[:, :, 0], fp[:, :, 2] - fp[:, :, 0])
+    area2 = jnp.linalg.norm(fn, axis=-1)                    # [T,4]
+    fn_u = fn / (area2[..., None] + EPSD)
+    # seed: the largest incident boundary face per ridge vertex
+    rec_v = jnp.concatenate(
+        [jnp.where(isb[:, f] & is_ridge_v[fv[:, f, k]], fv[:, f, k],
+                   capP) for f in range(4) for k in range(3)])
+    rec_s = jnp.concatenate([area2[:, f] for f in range(4)
+                             for _ in range(3)])
+    rec_n = jnp.concatenate([fn_u[:, f] for f in range(4)
+                             for _ in range(3)])
+    smax = jnp.full(capP + 1, -jnp.inf, mesh.vert.dtype).at[rec_v].max(
+        rec_s, mode="drop")
+    at_max = (rec_v < capP) & (rec_s >= smax[jnp.clip(rec_v, 0, capP)])
+    t_ch = jnp.where(at_max, tie_hash(rec_v.shape[0]), PRI_MIN)
+    tmax = jnp.full(capP + 1, PRI_MIN, jnp.int32).at[
+        jnp.where(at_max, rec_v, capP)].max(t_ch, mode="drop")
+    seed_sel = at_max & (t_ch == tmax[jnp.clip(rec_v, 0, capP)])
+    seed = jnp.zeros((capP + 1, 3), mesh.vert.dtype).at[
+        jnp.where(seed_sel, rec_v, capP)].set(
+        jnp.where(seed_sel[:, None], rec_n, 0.0), mode="drop",
+        unique_indices=True)[:capP]
+    # side split: within ~22.5 deg of the seed = side 1, else side 2
+    # (patches meeting at a ridge differ by > ANGEDG = 45 deg)
+    dots = jnp.sum(rec_n * seed[jnp.clip(rec_v, 0, capP - 1)], axis=-1)
+    side1 = dots >= jnp.cos(jnp.pi / 8)
+    pay = jnp.concatenate(
+        [jnp.where(side1[:, None], rec_n, 0.0),
+         jnp.where(side1[:, None], 0.0, rec_n)], axis=1)    # [R,6]
+    acc = jnp.zeros((capP + 1, 6), mesh.vert.dtype).at[rec_v].add(
+        pay, mode="drop")[:capP]
+    n1 = acc[:, :3] / (jnp.linalg.norm(acc[:, :3], axis=-1,
+                                       keepdims=True) + EPSD)
+    n2 = acc[:, 3:] / (jnp.linalg.norm(acc[:, 3:], axis=-1,
+                                       keepdims=True) + EPSD)
+    n1 = jnp.where(is_ridge_v[:, None], n1, 0.0)
+    n2 = jnp.where(is_ridge_v[:, None], n2, 0.0)
+    return n1, n2
+
+
 def ridge_vertex_tangents(mesh: Mesh, et=None) -> jax.Array:
     """[capP, 3] unit tangent of the feature (ridge/ref) line at each
     MG_GEO/MG_REF vertex; zeros elsewhere.

@@ -748,7 +748,7 @@ def distributed_adapt_multi(mesh: Mesh, met, n_shards: int,
                     if nmoved:
                         comms = comms2
                         # weld the arrival neighborhoods (region-scoped)
-                        stacked, nweld = band_weld(
+                        stacked, glo_d, nweld = band_weld(
                             stacked, met_s, glo_d, glo, arr_slots,
                             n_shards, verbose=verbose)
                         if nweld < 0:     # region budget blown: full weld

@@ -569,6 +569,12 @@ def band_migrate_iteration(stacked: Mesh, met_s, glo_d,
     if nmoved == 0:
         return stacked2, met2, glo_d2, None, shared_prev, 0, None
 
+    # ---- exposed-face probe (budget-checked BEFORE any mirror mutation:
+    # the okf fallback must hand the caller an untouched numbering) -----
+    keys, slots, cnt, okf = exposed_face_probe(stacked2, glo_d2, KF=KF)
+    if not bool(okf):
+        return None
+
     # ---- host glo mirror sync (arrivals + liveness) ---------------------
     arr_rows = np.asarray(info["arr_rows"])
     arr_gids = np.asarray(info["arr_gids"])
@@ -578,10 +584,7 @@ def band_migrate_iteration(stacked: Mesh, met_s, glo_d,
         glo[s][arr_rows[s][m]] = arr_gids[s][m].astype(np.int64)
         glo[s][~vmask_h[s]] = -1
 
-    # ---- exposed-face probe + cross-shard match -------------------------
-    keys, slots, cnt, okf = exposed_face_probe(stacked2, glo_d2, KF=KF)
-    if not bool(okf):
-        return None
+    # ---- cross-shard face match -----------------------------------------
     keys = np.asarray(keys)
     slots = np.asarray(slots)
     cnt = np.asarray(cnt)
@@ -692,7 +695,7 @@ def band_weld(stacked: Mesh, met_s, glo_d, glo: list[np.ndarray],
     trow, vrow, tcnt, vcnt, v_open, ok = band_region_probe(
         stacked, glo_d, seed, KW=KW, KWp=KWp)
     if not bool(ok):
-        return stacked, -1          # caller may fall back
+        return stacked, glo_d, -1   # caller may fall back
     trow = np.asarray(trow)
     vrow = np.asarray(vrow)
     tcnt = np.asarray(tcnt)
@@ -712,6 +715,7 @@ def band_weld(stacked: Mesh, met_s, glo_d, glo: list[np.ndarray],
     tet_d = stacked.tet
     tmask_d = stacked.tmask
     vmask_d = stacked.vmask
+    glo_d_out = glo_d
     ntot = 0
     for s in range(S):
         nt, nv = int(tcnt[s]), int(vcnt[s])
@@ -743,10 +747,15 @@ def band_weld(stacked: Mesh, met_s, glo_d, glo: list[np.ndarray],
         if len(dead_v):
             vmask_d = vmask_d.at[s, jnp.asarray(dead_v)].set(False)
             glo[s][dead_v] = -1
+            # the DEVICE numbering must drop the welded gids too: the
+            # next adapt cycles run before extend_ids_device and can
+            # reuse these slots — a stale gid there would resurrect
+            # under the old identity and corrupt shared-vertex matching
+            glo_d_out = glo_d_out.at[s, jnp.asarray(dead_v)].set(-1)
     if ntot == 0:
-        return stacked, 0
+        return stacked, glo_d_out, 0
     if verbose >= 2:
         print(f"  band weld: {ntot} near-duplicate pairs contracted")
     out = dataclasses.replace(stacked, tet=tet_d, tmask=tmask_d,
                               vmask=vmask_d)
-    return out, ntot
+    return out, glo_d_out, ntot

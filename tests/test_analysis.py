@@ -92,3 +92,41 @@ def test_open_boundary_nonmanifold():
     res = analyze_mesh(m)
     vtag = np.asarray(res.mesh.vtag)
     assert (vtag[0] & C.MG_NOM) and (vtag[1] & C.MG_NOM)
+
+
+def test_ridge_per_side_normals_cube():
+    """Ridge vertices of the unit cube store the TWO adjacent face
+    normals (the reference's xPoint n1/n2, analys_pmmg.c:199-1171),
+    not their meaningless average."""
+    import numpy as np
+    import jax.numpy as jnp
+    from parmmg_tpu.core.mesh import make_mesh
+    from parmmg_tpu.core import constants as C
+    from parmmg_tpu.ops.analysis import analyze_mesh, ridge_vertex_normals
+    from parmmg_tpu.utils.fixtures import cube_mesh
+
+    vert, tet = cube_mesh(4)
+    m = make_mesh(vert, tet, capP=2 * len(vert), capT=2 * len(tet))
+    m = analyze_mesh(m).mesh
+    n1, n2 = ridge_vertex_normals(m)
+    n1, n2 = np.asarray(n1), np.asarray(n2)
+    vtag = np.asarray(m.vtag)
+    vm = np.asarray(m.vmask)
+    ridge = vm & ((vtag & C.MG_GEO) != 0) & ((vtag & C.MG_CRN) == 0) & \
+        ((vtag & C.MG_NOM) == 0)
+    assert ridge.sum() > 0, "cube edges must carry ridge vertices"
+    vh = np.asarray(m.vert)
+    for i in np.where(ridge)[0]:
+        # each cube-edge vertex sits on exactly two axis faces: both
+        # per-side normals must be +-axis unit vectors, and different
+        a, b = n1[i], n2[i]
+        assert np.isclose(np.abs(a).max(), 1.0, atol=1e-5), (i, a)
+        assert np.isclose(np.abs(b).max(), 1.0, atol=1e-5), (i, b)
+        assert not np.allclose(a, b), (i, a, b)
+        # both are outward normals of faces the vertex lies on
+        for n in (a, b):
+            ax = int(np.argmax(np.abs(n)))
+            face_val = 1.0 if n[ax] > 0 else 0.0
+            assert np.isclose(vh[i][ax], face_val, atol=1e-9), (i, n)
+    # off-ridge rows are zero
+    assert (n1[~ridge] == 0).all() and (n2[~ridge] == 0).all()

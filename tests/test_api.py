@@ -203,115 +203,3 @@ def test_node_communicator_owners():
     assert owners[0].tolist() == [1, 1, 1, 1]
     assert globs[0].tolist() == [10, 20, 30, 40]
     assert (nuniq, ntot) == (4, 4)
-
-
-def test_entity_getters_after_adapt():
-    """Single-entity + edge/normal/met getters (PMMG_Get_vertex/
-    tetrahedron/triangle/edge/normalAtVertex, API_functions_pmmg.c)."""
-    pm, vert, tet = _staged_cube(2, niter=1)
-    pm.set_met_size(1, len(vert))
-    pm.set_scalar_mets(np.full(len(vert), 0.4))
-    assert pm.run() == C.PMMG_SUCCESS
-
-    npo, ne, nprism, nt, nquad, na = pm.get_mesh_size()
-    x, y, z, ref, crn, req = pm.get_vertex(1)
-    assert all(np.isfinite([x, y, z]))
-    v = pm.get_tetrahedron(1)
-    assert len(v) == 6 and all(1 <= q <= npo for q in v[:4])
-    t = pm.get_triangle(1)
-    assert len(t) == 5 and all(1 <= q <= npo for q in t[:3])
-
-    # the unit cube has 12 sharp ridges -> feature edges must exist and
-    # their endpoints must lie on the surface
-    edges, erefs, eridge, ereq = pm.get_edges()
-    assert len(edges) > 0 and eridge.any()
-    assert edges.min() >= 1 and edges.max() <= npo
-    e0 = pm.get_edge(1)
-    assert len(e0) == 5
-    # cube corners are detected as corner vertices
-    verts, _ = pm.get_vertices()
-    crns = [i + 1 for i in range(npo) if pm.get_vertex(i + 1)[4]]
-    assert len(crns) >= 8
-
-    # normals: unit length on smooth boundary points, zero inside
-    vn = pm.get_normals()
-    ln = np.linalg.norm(vn, axis=1)
-    assert ((np.isclose(ln, 1, atol=1e-4)) | (ln < 1e-6)).all()
-    nx, ny, nz = pm.get_normal_at_vertex(1)
-
-    # metric getters
-    assert pm.get_scalar_met(1) > 0
-    assert len(pm.get_scalar_mets()) == npo
-
-    # triangle global numbering (single-process identity)
-    tg = pm.get_triangles_glonum()
-    assert len(tg) == nt and tg[0] == 1 == pm.get_triangle_glonum(1)
-
-
-def test_prisms_quads_passthrough():
-    pm, vert, tet = _staged_cube(1, niter=1, noinsert=1, noswap=1, nomove=1)
-    pm.set_mesh_size(np_=len(vert), ne=len(tet), nprism=1, nquad=1)
-    pm.set_vertices(vert)
-    pm.set_tetrahedra(tet + 1)
-    pm.set_prism([1, 2, 3, 4, 5, 6], 7, 1)
-    pm.set_quadrilateral([1, 2, 3, 4], 9, 1)
-    prisms, prefs = pm.get_prisms()
-    quads, qrefs = pm.get_quadrilaterals()
-    assert prisms.tolist() == [[1, 2, 3, 4, 5, 6]]
-    assert quads.tolist() == [[1, 2, 3, 4]]
-
-
-def test_print_communicator(tmp_path):
-    pm = ParMesh(nprocs=2, myrank=0)
-    pm.set_mesh_size(np_=8, ne=6)
-    pm.set_number_of_node_communicators(1)
-    pm.set_ith_node_communicator_size(0, color_out=1, nitem=2)
-    pm.set_ith_node_communicator_nodes(0, [1, 2], [10, 20])
-    out = tmp_path / "comm.txt"
-    pm.print_communicator(str(out))
-    txt = out.read_text()
-    assert "node communicators: 1" in txt and "color_out 1" in txt
-
-
-def test_required_tetrahedron_frozen():
-    """set_required_tetrahedron freezes the tet through adaptation
-    (PMMG/Mmg required-tet contract) and get_tetrahedron reports it."""
-    pm, vert, tet = _staged_cube(2, niter=1)
-    pm.set_met_size(1, len(vert))
-    pm.set_scalar_mets(np.full(len(vert), 0.3))
-    req = 5                                      # arbitrary interior tet
-    pm.set_required_tetrahedron(req)
-    orig = np.sort(vert[tet[req - 1]], axis=0)
-    assert pm.run() == C.PMMG_SUCCESS
-    v, _ = pm.get_vertices()
-    t, _ = pm.get_tetrahedra()
-    # the required tet's 4 vertices survive at identical coordinates and
-    # some output tet connects exactly those 4 vertices
-    found = False
-    for row in t:
-        pts = np.sort(v[row - 1], axis=0)
-        if pts.shape == orig.shape and np.allclose(pts, orig, atol=1e-6):
-            found = True
-            break
-    assert found
-    # and at least one output tet reads back as required
-    npo, ne, *_ = pm.get_mesh_size()
-    assert any(pm.get_tetrahedron(i + 1)[5] for i in range(ne))
-
-
-def test_prism_vertices_frozen_and_remapped():
-    pm, vert, tet = _staged_cube(2, niter=1)
-    pm.set_mesh_size(np_=len(vert), ne=len(tet), nprism=1)
-    pm.set_vertices(vert)
-    pm.set_tetrahedra(tet + 1)
-    pm.set_met_size(1, len(vert))
-    pm.set_scalar_mets(np.full(len(vert), 0.3))
-    pv = [1, 2, 3, 5, 6, 7]
-    pm.set_prism(pv, 4, 1)
-    before = vert[np.array(pv) - 1]
-    assert pm.run() == C.PMMG_SUCCESS
-    prisms, prefs = pm.get_prisms()
-    assert prefs[0] == 4
-    v, _ = pm.get_vertices()
-    after = v[prisms[0] - 1]
-    assert np.allclose(before, after, atol=1e-6)
