@@ -756,6 +756,11 @@ def distributed_adapt_multi(mesh: Mesh, met, n_shards: int,
                             stacked, _ = weld_shard_bands(
                                 stacked, views_w, glo, n_shards,
                                 verbose=verbose)
+                            # the full weld freed host-glo rows; the
+                            # device copy must drop them too (stale
+                            # gids resurrect — see band_weld)
+                            glo_d = jnp.asarray(
+                                np.stack(glo).astype(np.int32))
                         stacked = rebuild_shards(stacked)
                         check_interface_echo(stacked, met_s, comms,
                                              dmesh, vert_h)
