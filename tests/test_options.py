@@ -70,9 +70,3 @@ def test_nosurf_freezes_boundary_vertices():
 def test_optim_without_metric():
     pm = _run_ok(_staged(optim=True))
     assert pm.stats.cycles >= 1
-
-
-def test_hsiz_drives_target_size():
-    pm = _run_ok(_staged(hsiz=0.18))
-    _, ne_out, *_ = pm.get_mesh_size()
-    assert ne_out > len(cube_mesh(3)[1])       # refined vs 0.33 spacing

@@ -1,0 +1,39 @@
+"""Option-matrix tests, part C — split from test_options.py (second split:
+the XLA:CPU long-process segfault moved to the 6th test as this session
+added compiled programs per process; same mitigation as _b).
+"""
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from parmmg_tpu.api import ParMesh, IParam, DParam
+from parmmg_tpu.core import constants as C
+from parmmg_tpu.core.mesh import tet_volumes
+from parmmg_tpu.utils.fixtures import cube_mesh
+
+
+def _staged(n=3, **info_kw):
+    vert, tet = cube_mesh(n)
+    pm = ParMesh()
+    pm.set_mesh_size(np_=len(vert), ne=len(tet))
+    pm.set_vertices(vert)
+    pm.set_tetrahedra(tet + 1)
+    pm.info.niter = 1
+    pm.info.imprim = -1
+    for k, v in info_kw.items():
+        setattr(pm.info, k, v)
+    return pm
+
+
+def _run_ok(pm):
+    assert pm.run() == C.PMMG_SUCCESS
+    vols = np.asarray(tet_volumes(pm._out))[np.asarray(pm._out.tmask)]
+    assert (vols > 0).all()
+    assert np.isclose(vols.sum(), 1.0, rtol=1e-4)
+    return pm
+
+
+def test_hsiz_drives_target_size():
+    pm = _run_ok(_staged(hsiz=0.18))
+    _, ne_out, *_ = pm.get_mesh_size()
+    assert ne_out > len(cube_mesh(3)[1])       # refined vs 0.33 spacing
