@@ -13,12 +13,13 @@ libparmmg.c:464-1105):
   exchange jits under ``shard_map``;
 - the canonical ParMmg exchange idiom (scatter->Sendrecv->merge with an
   owner rule, e.g. libparmmg.c:743-790) becomes ``halo_exchange``:
-  gather item values -> ``all_gather`` over the shard axis (rides ICI) ->
-  each shard statically gathers its neighbors' mirrored buffers -> merge
-  (min/max/sum).  Matching item order on both sides is guaranteed by
-  construction: both sides sort items by *global* entity key — the
-  ordering contract of the reference API (API_functions_pmmg.c:1295-1330,
-  SURVEY A.4);
+  gather item values into per-neighbor send rows -> ``all_to_all`` over
+  the shard axis (rides ICI; O(S*I) traffic, each shard ships only its
+  own neighbor rows) -> each shard reads the mirrored row it received
+  from each neighbor -> merge (min/max/sum).  Matching item order on the
+  two sides of a pair is guaranteed by construction: both sides sort
+  items by *global* entity key — the ordering contract of the reference
+  API (API_functions_pmmg.c:1295-1330, SURVEY A.4);
 - owner rule: max shard id touching the entity (libparmmg.c:962-973);
 - the chkcomm "coordinate echo" oracle becomes :func:`check_node_comms`:
   exchange actual coordinates and compare within a bbox-scaled epsilon
