@@ -83,7 +83,7 @@ def main() -> None:
         float(os.environ["JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS"]))
 
     from parmmg_tpu.core.mesh import make_mesh
-    from parmmg_tpu.ops.adapt import adapt_cycles_fused
+    from parmmg_tpu.ops.active import adapt_cycles_auto
     from parmmg_tpu.ops.analysis import analyze_mesh
     from parmmg_tpu.ops.quality import tet_quality
     from parmmg_tpu.utils.fixtures import cube_mesh, analytic_iso_metric
@@ -116,23 +116,31 @@ def main() -> None:
     # in the timed loop) is what kills the consistent ~170s first-block
     # artifact.  Then warm every other distinct flavor by EXECUTING it on
     # a copy of the state (AOT .lower().compile() would not populate the
-    # jit dispatch cache).
-    m1, k1, wcnt = adapt_cycles_fused(mesh, met, jnp.asarray(0, jnp.int32),
-                                      n_cycles=block, swap_every=3,
-                                      budget_div=bdiv)
+    # jit dispatch cache).  The auto block (ops/active.py) carries the
+    # worklist state (dirty, okflag); each cycle inside runs
+    # active-scoped when the worklist is valid and fits — the same
+    # program the production driver dispatches.
+    def _flags(nc, off):
+        return tuple((c + off) % 3 == 2 for c in range(nc))
+
+    dirty = jnp.zeros(mesh.capP, bool)
+    okflag = jnp.asarray(False)
+    m1, k1, dirty, okflag, wcnt = adapt_cycles_auto(
+        mesh, met, dirty, okflag, jnp.asarray(0, jnp.int32),
+        swap_flags=_flags(block, 0), budget_div=bdiv)
     jax.block_until_ready(wcnt)
-    m1, k1, wcnt = adapt_cycles_fused(m1, k1, jnp.asarray(block, jnp.int32),
-                                      n_cycles=block, swap_every=3,
-                                      swap_offset=block % 3,
-                                      budget_div=bdiv)
+    m1, k1, dirty, okflag, wcnt = adapt_cycles_auto(
+        m1, k1, dirty, okflag, jnp.asarray(block, jnp.int32),
+        swap_flags=_flags(block, block % 3), budget_div=bdiv)
     jax.block_until_ready(wcnt)
     for nc, off in sorted({(nc, off) for _, nc, off in sched}
                           - {(block, 0)}):
         mc = jax.tree.map(jnp.copy, m1)
         kc = jnp.copy(k1)
-        _, _, c = adapt_cycles_fused(mc, kc, jnp.asarray(0, jnp.int32),
-                                     n_cycles=nc, swap_every=3,
-                                     swap_offset=off, budget_div=bdiv)
+        dc = jnp.copy(dirty)
+        _, _, _, _, c = adapt_cycles_auto(
+            mc, kc, dc, okflag, jnp.asarray(0, jnp.int32),
+            swap_flags=_flags(nc, off), budget_div=bdiv)
         jax.block_until_ready(c)
 
     # timed loop: cycles run in fused blocks of `block` (one dispatch +
@@ -143,19 +151,24 @@ def main() -> None:
     m, k = m1, k1
     live, times = [], []
     prev_live = ntet0
+    narrow_cycles = 0
     for b, nc, off in sched:
         t0 = time.perf_counter()
-        m, k, counts = adapt_cycles_fused(
-            m, k, jnp.asarray(warm_cycles + b, jnp.int32), n_cycles=nc,
-            swap_every=3, swap_offset=off, budget_div=bdiv)
+        m, k, dirty, okflag, counts = adapt_cycles_auto(
+            m, k, dirty, okflag,
+            jnp.asarray(warm_cycles + b, jnp.int32),
+            swap_flags=_flags(nc, off), budget_div=bdiv)
         cs = np.asarray(counts)                   # blocks on this block
         times.append(time.perf_counter() - t0)
+        narrow_cycles += int(cs[:, 7].sum())
         if os.environ.get("BENCH_DEBUG", "") == "1":
             for r in cs:
+                nact = int(r[8]) if len(r) > 8 else -1
                 print(f"bench:   cycle counts split={int(r[0]):6d} "
                       f"col={int(r[1]):6d} swap={int(r[2]):6d} "
-                      f"move={int(r[3]):6d} live={int(r[5]):6d}",
-                      file=sys.stderr)
+                      f"move={int(r[3]):6d} live={int(r[5]):6d} "
+                      f"defer={int(r[6])} narrow={int(r[7])} "
+                      f"nact={nact}", file=sys.stderr)
         # tets examined this block = sum over cycles of live-at-entry
         entries = [prev_live] + [int(r[5]) for r in cs[:-1]]
         live.append(int(np.sum(entries)))
@@ -181,25 +194,75 @@ def main() -> None:
     # only, quality is reported for the full pipeline's output
     from parmmg_tpu.ops.adapt import sliver_polish
     from parmmg_tpu.ops.repair import repair_mesh
-    for w in range(6):
-        m, pc = sliver_polish(m, k, jnp.asarray(100 + w, jnp.int32))
-        if int(np.asarray(pc)[0]) == 0 and int(np.asarray(pc)[1]) == 0:
-            break
-    m, _nrep = repair_mesh(m, k)
 
-    q = np.asarray(tet_quality(m))
-    tm = np.asarray(m.tmask)
-    qmin = float(q[tm].min()) if tm.any() else 0.0
-    qmean = float(q[tm].mean()) if tm.any() else 0.0
+    def _quality_tail(mm, kk, wave0):
+        for w in range(6):
+            mm, pc = sliver_polish(mm, kk,
+                                   jnp.asarray(wave0 + w, jnp.int32))
+            if int(np.asarray(pc)[0]) == 0 and                     int(np.asarray(pc)[1]) == 0:
+                break
+        mm, _ = repair_mesh(mm, kk)
+        qq = np.asarray(tet_quality(mm))
+        tmm = np.asarray(mm.tmask)
+        return (mm, int(tmm.sum()),
+                float(qq[tmm].min()) if tmm.any() else 0.0,
+                float(qq[tmm].mean()) if tmm.any() else 0.0)
+
+    m, ntets_final, qmin, qmean = _quality_tail(m, k, 100)
+
+    # ---- aniso datapoint (reference CI's torus-aniso analogue) ----------
+    # a smaller planar-shock TENSOR-metric workload, same protocol in
+    # miniature: warm one block, time the next ones.  Off by default
+    # only via BENCH_ANISO=0.
+    aniso = None
+    if os.environ.get("BENCH_ANISO", "1") == "1":
+        from parmmg_tpu.utils.fixtures import analytic_ani_metric
+        n_a = int(os.environ.get("BENCH_ANISO_N", "12"))
+        vert_a, tet_a = cube_mesh(n_a)
+        mesh_a = make_mesh(vert_a, tet_a, capP=3 * len(vert_a),
+                           capT=3 * len(tet_a))
+        mesh_a = analyze_mesh(mesh_a).mesh
+        ha = analytic_ani_metric(vert_a, "shock", h=1.5 / n_a)
+        met_a = jnp.zeros((mesh_a.capP, 6), mesh_a.vert.dtype)
+        met_a = met_a.at[: len(ha)].set(jnp.asarray(ha))
+        met_a = met_a.at[len(ha):, 0].set(1.0).at[len(ha):, 3].set(
+            1.0).at[len(ha):, 5].set(1.0)
+        da = jnp.zeros(mesh_a.capP, bool)
+        oka = jnp.asarray(False)
+        ma, ka_ = mesh_a, met_a
+        ma, ka_, da, oka, ca = adapt_cycles_auto(
+            ma, ka_, da, oka, jnp.asarray(0, jnp.int32),
+            swap_flags=_flags(block, 0), budget_div=bdiv)
+        jax.block_until_ready(ca)
+        prev_a = int(np.asarray(ca)[-1][5])
+        lv_a, tm_a = 0, 0.0
+        for b in range(2):
+            t0 = time.perf_counter()
+            ma, ka_, da, oka, ca = adapt_cycles_auto(
+                ma, ka_, da, oka,
+                jnp.asarray(block * (1 + b), jnp.int32),
+                swap_flags=_flags(block, (block * (1 + b)) % 3),
+                budget_div=bdiv)
+            cs_a = np.asarray(ca)
+            tm_a += time.perf_counter() - t0
+            lv_a += prev_a + int(np.sum(cs_a[:-1, 5]))
+            prev_a = int(cs_a[-1, 5])
+        ma, nta, qmin_a, qmean_a = _quality_tail(ma, ka_, 200)
+        aniso = {"mtets_per_sec": round(lv_a / tm_a / 1e6, 4),
+                 "ntets_final": nta,
+                 "qmin": round(qmin_a, 4),
+                 "qmean": round(qmean_a, 4)}
 
     print(json.dumps({
         "metric": "adapt_cycle_throughput",
         "value": round(mtets_per_sec, 4),
         "unit": "Mtets/sec/chip",
         "vs_baseline": round(mtets_per_sec / BASELINE_MTETS_PER_SEC, 3),
-        "extra": {"ntets_final": int(tm.sum()), "qmin": round(qmin, 4),
+        "extra": {"ntets_final": ntets_final, "qmin": round(qmin, 4),
                   "qmean": round(qmean, 4), "cycles": cycles,
                   "sum_rate": round(mtets_sum, 4),
+                  "narrow_cycles": narrow_cycles,
+                  "aniso": aniso,
                   "device": str(jax.devices()[0].platform),
                   "fallback": os.environ.get(
                       "PARMMG_BENCH_FALLBACK", "") == "1"},

@@ -62,7 +62,8 @@ def adapt_cycle_impl(mesh: Mesh, met: jax.Array, wave: jax.Array,
                      final_rebuild: bool = True,
                      hausd: float | None = None,
                      budget_div: int = 8,
-                     et0=None):
+                     et0=None, vact=None, submesh: bool = False,
+                     wide: bool = False, wwin=None):
     """One adaptation cycle: split -> collapse -> [swap] -> [smooth].
 
     Pure jittable function (jitted wrapper below) — also the compile-check
@@ -77,14 +78,23 @@ def adapt_cycle_impl(mesh: Mesh, met: jax.Array, wave: jax.Array,
     valid-adja contract for external callers; fused blocks skip it
     between cycles.
 
+    ``vact``/``submesh``: active-scoped narrow mode (ops/active.py) —
+    candidates are restricted to active vertices and the adjacency
+    rebuilds skip boundary tagging (a sub-mesh's unmatched faces are
+    cut faces, not surface).
+
     Returns (mesh, met, counts) with ``counts`` = int32
-    [nsplit, ncollapse, nswap, nmoved, overflow, live_tets] stacked in
-    ONE device array: the host reads all per-cycle counters with a single
-    transfer (each separate scalar pull costs a full round trip on a
-    remote-device transport, and an *eager* count op on the host would
-    fight the donated input buffers).
+    [nsplit, ncollapse, nswap, nmoved, overflow, live_tets, deferred,
+    narrow_abort] stacked in ONE device array: the host reads all
+    per-cycle counters with a single transfer (each separate scalar pull
+    costs a full round trip on a remote-device transport, and an *eager*
+    count op on the host would fight the donated input buffers).
+    ``deferred`` = any wave cut viable candidates at its top-K budget
+    (the narrow path's entry precondition is a False here);
+    ``narrow_abort`` is always 0 on this full-width path.
     """
     from .adjacency import boundary_edge_tags
+    defer = jnp.zeros((), bool)
     if do_insert:
         # ONE edge table + metric lengths serve both split and collapse
         # (the tables are a measured wave hot spot); the collapse defers
@@ -106,15 +116,22 @@ def adapt_cycle_impl(mesh: Mesh, met: jax.Array, wave: jax.Array,
         if hausd is not None:
             from .analysis import ridge_vertex_tangents
             vtan0 = ridge_vertex_tangents(mesh, et=et0)
+        # wide convergence-verification cycles disable the approximate
+        # nomination prescreen so shells it over-vetoed get one exact
+        # re-evaluation before convergence is accepted (split.py)
         res = split_wave(mesh, met, hausd=hausd, budget_div=budget_div,
-                         et=et0, lens=lens0, vtan=vtan0)
+                         et=et0, lens=lens0, vtan=vtan0, vact=vact,
+                         prescreen=not wide)
         mesh, met = res.mesh, res.met
         nsplit, overflow = res.nsplit, res.overflow
+        defer = defer | res.deferred
 
         col = collapse_wave(mesh, met, hausd=hausd,
                             budget_div=budget_div,
                             et=et0, lens=lens0,
-                            stale_tets=res.modified, vtan=vtan0)
+                            stale_tets=res.modified, vtan=vtan0,
+                            vact=vact, wwin=wwin)
+        defer = defer | col.deferred
         # collapse rewires the surface (dying tets' face tags transfer to
         # the surviving neighbors); re-propagate MG_BDY from faces to
         # their edges and vertices so later splits/smooth treat the new
@@ -134,31 +151,42 @@ def adapt_cycle_impl(mesh: Mesh, met: jax.Array, wave: jax.Array,
     nswap = jnp.zeros((), jnp.int32)
     if do_swap:
         sew = swap_edges_wave(mesh, met, hausd=hausd,
-                              budget_div=budget_div)  # 3-2 + 2-2
-        mesh = build_adjacency(sew.mesh)        # consumed by swap23
-        s23 = swap23_wave(mesh, met, budget_div=budget_div)
+                              budget_div=budget_div,
+                              vact=vact, wwin=wwin)  # 3-2 + 2-2
+        # consumed by swap23 (adja-only on a sub-mesh: cut faces are
+        # unmatched without being surface)
+        mesh = build_adjacency(sew.mesh, set_bdy_tags=not submesh)
+        s23 = swap23_wave(mesh, met, budget_div=budget_div, wwin=wwin)
         mesh = s23.mesh
         nswap = sew.nswap + s23.nswap
+        defer = defer | sew.deferred | s23.deferred
 
     nmoved = jnp.zeros((), jnp.int32)
     if do_smooth:
+        # in windowed mode (wwin, the ops/active.py rotation) smoothing
+        # restricts to the window; in narrow mode vact (the worklist
+        # closure, itself window-derived) is the restriction
+        sv = vact if vact is not None else wwin
         for w in range(smooth_waves):
-            sm = smooth_wave(mesh, met, wave=wave * smooth_waves + w)
+            sm = smooth_wave(mesh, met, wave=wave * smooth_waves + w,
+                             vact=sv)
             mesh = sm.mesh
             nmoved = nmoved + sm.nmoved
 
     if final_rebuild:
-        mesh = build_adjacency(mesh)
+        mesh = build_adjacency(mesh, set_bdy_tags=not submesh)
 
     counts = jnp.stack([nsplit, ncol, nswap, nmoved,
                         overflow.astype(jnp.int32),
-                        jnp.sum(mesh.tmask, dtype=jnp.int32)])
+                        jnp.sum(mesh.tmask, dtype=jnp.int32),
+                        defer.astype(jnp.int32),
+                        jnp.zeros((), jnp.int32)])
     return mesh, met, counts
 
 
 adapt_cycle = partial(jax.jit, static_argnames=(
     "do_swap", "do_smooth", "smooth_waves", "do_insert", "final_rebuild",
-    "hausd", "budget_div"),
+    "hausd", "budget_div", "submesh", "wide"),
     donate_argnums=(0, 1))(adapt_cycle_impl)
 
 
@@ -370,6 +398,10 @@ def adapt_mesh(mesh: Mesh, met: jax.Array, max_cycles: int = 50,
     wide_check = False
     converged = False
     cycle = 0
+    # worklist state threaded through auto blocks (ops/active.py):
+    # zeros/False = no worklist yet, first cycles run full-width
+    dirty = None                 # [capP] bool device array
+    okflag = False
     while cycle < max_cycles and not converged:
         # capacity management before the wave block (each block can add
         # up to block * 2*capT/8 tets; the overflow flag + regrow below
@@ -380,6 +412,8 @@ def adapt_mesh(mesh: Mesh, met: jax.Array, max_cycles: int = 50,
                                       max(mesh.capP, int(2 * n_p)),
                                       max(mesh.capT, int(2 * n_t)))
             stats.regrows += 1
+            dirty = None        # regrow permuted slots; footprint stale
+            okflag = False
 
         was_wide = wide_check
         # single-cycle dispatch when quiet: the quiet>0-forces-swap rule
@@ -391,15 +425,25 @@ def adapt_mesh(mesh: Mesh, met: jax.Array, max_cycles: int = 50,
             mesh, met, counts = adapt_cycle(
                 mesh, met, jnp.asarray(cycle, jnp.int32), do_swap=do_swap,
                 do_smooth=not nomove, do_insert=not noinsert, hausd=hausd,
-                budget_div=2 if wide_check else 8)
+                budget_div=2 if wide_check else 8, wide=wide_check)
             rows = [(do_swap, np.asarray(counts))]
+            dirty = None        # full wide pass: worklist invalid
+            okflag = False
         else:
+            # self-width-selecting fused block (ops/active.py): each
+            # cycle runs active-scoped when its worklist is valid and
+            # fits, full-width otherwise — one dispatch either way
+            from .active import adapt_cycles_auto
             nblk = min(cycle_block, max_cycles - cycle)
             flags = tuple(
                 (((cycle + c) % swap_every == swap_every - 1)
                  and not noswap) for c in range(nblk))
-            mesh, met, counts_all = adapt_cycles_fused(
-                mesh, met, jnp.asarray(cycle, jnp.int32),
+            if dirty is None:
+                dirty = jnp.zeros(mesh.capP, bool)
+                okflag = False
+            mesh, met, dirty, okflag, counts_all = adapt_cycles_auto(
+                mesh, met, dirty, jnp.asarray(bool(okflag)),
+                jnp.asarray(cycle, jnp.int32),
                 swap_flags=flags, hausd=hausd,
                 do_smooth=not nomove, do_insert=not noinsert)
             ca = np.asarray(counts_all)
@@ -407,7 +451,7 @@ def adapt_mesh(mesh: Mesh, met: jax.Array, max_cycles: int = 50,
 
         ovf_any = False
         for do_swap, cnt in rows:
-            ns, nc, nw, nm, ovf, _ = (int(v) for v in cnt)
+            ns, nc, nw, nm, ovf = (int(v) for v in cnt[:5])
             stats.nsplit += ns
             stats.ncollapse += nc
             stats.nswap += nw
@@ -455,6 +499,8 @@ def adapt_mesh(mesh: Mesh, met: jax.Array, max_cycles: int = 50,
             mesh, met = grow_mesh_met(mesh, met, 2 * mesh.capP,
                                       2 * mesh.capT)
             stats.regrows += 1
+            okflag = False
+            dirty = None
 
     # bad-element optimization: the sizing loop leaves slivers whose edge
     # lengths are all in-range; polish until no sliver op applies

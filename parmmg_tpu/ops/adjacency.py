@@ -34,12 +34,17 @@ def _face_keys(mesh: Mesh):
     return fv, tetid, faceid
 
 
-def build_adjacency(mesh: Mesh) -> Mesh:
+def build_adjacency(mesh: Mesh, set_bdy_tags: bool = True) -> Mesh:
     """Compute ``adja`` and mark unmatched faces as boundary (MG_BDY).
 
     In a conforming mesh every interior face appears exactly twice. After
     sorting face keys, twins are neighbors in sorted order; the pairing is
     scattered back as ``adja[t,f] = 4*t' + f'``.
+
+    ``set_bdy_tags=False`` computes adja only: on an active SUB-mesh
+    (ops/active.py) faces whose twin lies outside the sub-mesh are
+    unmatched without being boundary — tagging them MG_BDY would corrupt
+    the surface, while adja=-1 correctly excludes them from swap23.
     """
     from .edges import PACK_LIMIT
     capT = mesh.capT
@@ -76,6 +81,8 @@ def build_adjacency(mesh: Mesh) -> Mesh:
                              unique_indices=True)
     adja = jnp.where(mesh.tmask[:, None], adja, -1)
 
+    if not set_bdy_tags:
+        return dataclasses_replace(mesh, adja=adja)
     # boundary faces: valid tet, face has no twin
     is_bdy = (adja < 0) & mesh.tmask[:, None]
     ftag = jnp.where(is_bdy, mesh.ftag | MG_BDY, mesh.ftag)

@@ -44,6 +44,8 @@ class CollapseResult(NamedTuple):
     # did any dying tet donate face/edge tags (surface rewired)?  False
     # lets the caller skip the boundary re-propagation pass entirely
     surface_changed: jax.Array = None
+    deferred: jax.Array = None  # scalar bool: candidates exceeded the
+    #                 top-K budget (see ops/active.py worklist invariant)
 
 
 def _removable(vtag, other_vtag, edge_tag):
@@ -71,7 +73,9 @@ def collapse_wave(mesh: Mesh, met: jax.Array, lmin: float = LSHRT,
                   budget_div: int = 8,
                   et=None, lens=None,
                   stale_tets: jax.Array | None = None,
-                  vtan: jax.Array | None = None) -> CollapseResult:
+                  vtan: jax.Array | None = None,
+                  vact: jax.Array | None = None,
+                  wwin: jax.Array | None = None) -> CollapseResult:
     """One independent-set collapse wave.
 
     Normal mode: contract edges shorter than ``lmin`` (Mmg's colver over
@@ -121,6 +125,20 @@ def collapse_wave(mesh: Mesh, met: jax.Array, lmin: float = LSHRT,
         # don't lengthen already-long edges by contracting into them
         short = et.emask & bad_edge & ~frozen_edge & (lens < lmax)
 
+    if vact is not None:
+        # narrow-path restriction (ops/active.py): both endpoints active
+        # — the removed endpoint's whole ball is then in the sub-mesh,
+        # keeping the ball-quality gate below exact
+        short = short & vact[va_f] & vact[vb_f]
+    if wwin is not None:
+        # spatial-window rotation (ops/active.py): collapse candidates
+        # restrict to the current morton window UNCONDITIONALLY — the
+        # steady-state candidate pool exceeds the top-K budget anyway
+        # (the global pass never attempts the backlog), while the
+        # window's share fits the budget, so rotation ATTEMPTS EVERY
+        # candidate within nwin cycles — strictly better coverage, and
+        # the winners' footprints stay spatially compact
+        short = short & wwin[va_f] & wwin[vb_f]
     ta_f, tb_f = mesh.vtag[va_f], mesh.vtag[vb_f]
     rem_b_f = _removable(tb_f, ta_f, et.etag)   # can delete b (keep a)
     rem_a_f = _removable(ta_f, tb_f, et.etag)
@@ -158,7 +176,7 @@ def collapse_wave(mesh: Mesh, met: jax.Array, lmin: float = LSHRT,
     # candidacy masks.
     def _idle(_):
         return CollapseResult(mesh, jnp.zeros((), jnp.int32),
-                              jnp.zeros((), bool))
+                              jnp.zeros((), bool), jnp.zeros((), bool))
 
     def _act(_):
         # top-K compaction (scripts/wave_time.py cost lever): the K highest-
@@ -169,6 +187,7 @@ def collapse_wave(mesh: Mesh, met: jax.Array, lmin: float = LSHRT,
         # exists to raise the min — edge length would misrank the targets)
         from .edges import wave_budget
         K = min(Efull, wave_budget(capT, budget_div))
+        defer = jnp.sum(pre.astype(jnp.int32)) > K
         if sliver_q is None:
             prio = lens
         else:
@@ -337,7 +356,7 @@ def collapse_wave(mesh: Mesh, met: jax.Array, lmin: float = LSHRT,
         out = dataclasses.replace(
             mesh, tet=new_tet, tmask=tmask, vmask=vmask, ftag=ftag,
             fref=fref, etag=etag)
-        return CollapseResult(out, ncol, schg)
+        return CollapseResult(out, ncol, schg, defer)
 
     return jax.lax.cond(jnp.any(pre), _act, _idle, None)
 

@@ -336,3 +336,28 @@ def scatter_argmax2(site: jax.Array, s: jax.Array, t: jax.Array,
     c_t = jnp.full(nsites + 1, PRI_MIN).at[safe2].max(
         jnp.where(at_max, t, PRI_MIN), mode="drop")
     return at_max & (t == c_t[sited]), c_s, c_t
+
+
+def morton_codes(pts: jax.Array, valid: jax.Array, bits: int = 10):
+    """[n] int32 morton (Z-order) codes of 3D points, normalized over
+    the bounding box of the ``valid`` rows; ``3*bits <= 30`` so the code
+    stays in int32.  Shared by the smoothing/worklist window rotation
+    (ops/smooth.morton_window_mask) and the device cluster assignment of
+    the graph-balancing probe (parallel/migrate_dev.graph_probe) — one
+    curve definition, one set of bit masks."""
+    lo = jnp.min(jnp.where(valid[:, None], pts, jnp.inf), axis=0)
+    hi = jnp.max(jnp.where(valid[:, None], pts, -jnp.inf), axis=0)
+    u = jnp.clip((pts - lo) / jnp.maximum(hi - lo, 1e-30),
+                 0.0, 1.0 - 1e-7)
+    q = (u * float(1 << bits)).astype(jnp.uint32)
+
+    def spread(x):          # interleave up to 10 bits -> every 3rd bit
+        x = (x | (x << 16)) & jnp.uint32(0x030000FF)
+        x = (x | (x << 8)) & jnp.uint32(0x0300F00F)
+        x = (x | (x << 4)) & jnp.uint32(0x030C30C3)
+        x = (x | (x << 2)) & jnp.uint32(0x09249249)
+        return x
+
+    code = spread(q[:, 0]) | (spread(q[:, 1]) << 1) | \
+        (spread(q[:, 2]) << 2)
+    return code.astype(jnp.int32)

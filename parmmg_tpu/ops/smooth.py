@@ -44,9 +44,25 @@ class SmoothResult(NamedTuple):
     nmoved: jax.Array
 
 
+def morton_window_mask(vert: jax.Array, vmask: jax.Array, wave,
+                       nwin: int) -> jax.Array:
+    """[capP] bool: vertices of the ``wave % nwin``-th contiguous
+    morton-curve segment.  Smoothing any independent SUBSET per wave is
+    valid (the claim scheme already rotates); choosing spatially
+    COHERENT subsets keeps each cycle's footprint a compact blob, which
+    is what lets the active-scoped narrow path (ops/active.py) hold the
+    worklist small — scattered moves have ~100-tet 2-hop stencils each,
+    a window's moves share theirs."""
+    from .edges import morton_codes
+    code = morton_codes(vert, vmask, bits=5)   # 15-bit morton
+    win = (code * nwin) // 32768
+    return win == jnp.mod(jnp.asarray(wave, jnp.int32), nwin)
+
+
 def smooth_wave(mesh: Mesh, met: jax.Array, wave: int = 0,
                 relax: float = 1.0,
-                opt_q: float | None = None) -> SmoothResult:
+                opt_q: float | None = None,
+                vact: jax.Array | None = None) -> SmoothResult:
     """One smoothing wave; see module docstring.
 
     ``opt_q``: optimal-position mode for sliver balls — interior
@@ -65,6 +81,12 @@ def smooth_wave(mesh: Mesh, met: jax.Array, wave: int = 0,
     reg_bdy = mesh.vmask & ((mesh.vtag & MG_BDY) != 0) & \
         ((mesh.vtag & (MG_REQ | MG_CRN | MG_PARBDY | MG_GEO | MG_NOM |
                        MG_REF)) == 0)
+    if vact is not None:
+        # narrow-path restriction (ops/active.py): only active vertices
+        # may move — their full ball is in the sub-mesh, so proposal and
+        # gate stay exact
+        movable_int = movable_int & vact
+        reg_bdy = reg_bdy & vact
 
     tv = mesh.tet
     vpos = mesh.vert[tv]                                   # [T,4,3]

@@ -45,6 +45,11 @@ class SplitResult(NamedTuple):
     modified: jax.Array = None  # [capT] bool: tets rewritten/created this
     #                 wave (consumed by collapse_wave's staleness veto
     #                 when both ops share one pre-split edge table)
+    deferred: jax.Array = None  # scalar bool: viable winners were dropped
+    #                 by the top-K / shell budgets (NOT by gates or
+    #                 capacity) — the active-scoped narrow path must see
+    #                 a False here before trusting its dirty-region
+    #                 worklist (ops/active.py)
 
 
 def _interp_met_mid(met, va, vb):
@@ -60,7 +65,9 @@ def split_wave(mesh: Mesh, met: jax.Array, lmax: float = LLONG,
                fem_only: bool = False,
                et: EdgeTable | None = None,
                lens: jax.Array | None = None,
-               vtan: jax.Array | None = None) -> SplitResult:
+               vtan: jax.Array | None = None,
+               vact: jax.Array | None = None,
+               prescreen: bool = True) -> SplitResult:
     """One independent-set split wave. Jittable; static shapes throughout.
 
     ``hausd`` enables the PLACEMENT half of surface-approximation
@@ -92,6 +99,12 @@ def split_wave(mesh: Mesh, met: jax.Array, lmax: float = LLONG,
     ``et``/``lens``: a caller-precomputed edge table + metric lengths of
     THIS mesh (adapt_cycle_impl builds one table serving both split and
     collapse — the tables are a measured hot spot of every wave).
+
+    ``vact``: optional [capP] bool active-vertex mask (the narrow path,
+    ops/active.py): only edges with BOTH endpoints active are candidates
+    — on a sub-mesh holding exactly the tets that touch active vertices,
+    such edges have their complete shell present, so shell counts and
+    the whole-shell nomination rule stay exact.
     """
     capT, capP = mesh.capT, mesh.capP
     if et is None:
@@ -110,6 +123,12 @@ def split_wave(mesh: Mesh, met: jax.Array, lmax: float = LLONG,
             ~frozen_edge
     else:
         cand = et.emask & (lens > lmax) & ~frozen_edge
+    if vact is not None:
+        cand = cand & vact[va] & vact[vb]
+    # NOTE splits are deliberately NOT window-restricted (unlike
+    # collapse/swap/smooth, ops/active.py): their steady-state count is
+    # ~zero (no footprint problem) while windowing them measurably slows
+    # the refinement phase
     lift_corr = None
     if hausd is not None:
         from .analysis import boundary_vertex_normals, \
@@ -155,7 +174,7 @@ def split_wave(mesh: Mesh, met: jax.Array, lmax: float = LLONG,
     def _idle(_):
         return SplitResult(mesh, met, jnp.zeros((), jnp.int32),
                            jnp.zeros((), bool),
-                           jnp.zeros(capT, bool))
+                           jnp.zeros(capT, bool), jnp.zeros((), bool))
 
     def _act(_):
         from .quality import quality_from_points
@@ -186,9 +205,14 @@ def split_wave(mesh: Mesh, met: jax.Array, lmax: float = LLONG,
         # their nominations HERE so such shells never pin top-K budget
         # slots wave after wave (starvation); the exact [KH] veto below
         # stays as the precise guard (incl. hausd-lifted midpoints,
-        # where the half-quality bound is only approximate).
-        q_par = quality_from_points(mesh.vert[mesh.tet])
-        nominate = nominate & (q_par > 4.0 * QUAL_FLOOR)[:, None]
+        # where the half-quality bound is only approximate — the bound
+        # is NOT exact for the quality measure, so near-floor parents
+        # can be over-vetoed; the wide convergence-verification cycle
+        # passes prescreen=False so blocked shells get re-evaluated by
+        # the exact veto before convergence is accepted).
+        if prescreen:
+            q_par = quality_from_points(mesh.vert[mesh.tet])
+            nominate = nominate & (q_par > 4.0 * QUAL_FLOOR)[:, None]
         has_nom = jnp.any(nominate, axis=1)
         loc_n = jnp.argmax(nominate, axis=1)              # [capT]
         e_n = jnp.clip(et.edge_id[ar0, loc_n], 0, capE - 1)
@@ -215,7 +239,13 @@ def split_wave(mesh: Mesh, met: jax.Array, lmax: float = LLONG,
         # would be silently dropped, splitting only part of a shell
         sh0 = jnp.where(wv, et.nshell[wcc], 0)
         toff0 = jnp.cumsum(sh0) - sh0
-        wv = wv & ((toff0 + sh0) <= KH)
+        shell_fit = (toff0 + sh0) <= KH
+        # budget deferral (top-K or shell-budget cut of VIABLE winners —
+        # gate/capacity drops are flagged elsewhere): the narrow path's
+        # worklist invariant needs to see this
+        defer = (jnp.sum(win0.astype(jnp.int32)) > KW) | \
+            jnp.any(wv & ~shell_fit)
+        wv = wv & shell_fit
 
         # --- degeneracy veto (MMG5_split1b cavity-quality check) -------------
         # evaluated on the [KH]-compacted shells of the budget winners
@@ -342,7 +372,7 @@ def split_wave(mesh: Mesh, met: jax.Array, lmax: float = LLONG,
         modified = jnp.zeros(capT, bool).at[tgt1].set(
             True, mode="drop", unique_indices=True).at[tgt2].set(
             True, mode="drop", unique_indices=True)
-        return SplitResult(out, met_new, nwin, overflow, modified)
+        return SplitResult(out, met_new, nwin, overflow, modified, defer)
 
     return jax.lax.cond(jnp.any(cand), _act, _idle, None)
 

@@ -37,6 +37,8 @@ for _e, (_i, _j) in enumerate([[0, 1], [0, 2], [0, 3],
 class SwapResult(NamedTuple):
     mesh: Mesh
     nswap: jax.Array
+    deferred: jax.Array = None  # scalar bool: candidates exceeded the
+    #                 top-K budget (see ops/active.py worklist invariant)
 
 
 def _met6(met):
@@ -51,7 +53,9 @@ def swap_edges_wave(mesh: Mesh, met: jax.Array, enable32: bool = True,
                     enable22: bool = True,
                     flat_tol: float = 1e-5,
                     hausd: float | None = None,
-                    budget_div: int = 8) -> SwapResult:
+                    budget_div: int = 8,
+                    vact: jax.Array | None = None,
+                    wwin: jax.Array | None = None) -> SwapResult:
     """Combined edge-swap wave: 3-2 interior + 2-2 boundary, ONE pass.
 
     Both swaps share the same cavity shape — edge (a,b) is replaced by two
@@ -120,9 +124,23 @@ def swap_edges_wave(mesh: Mesh, met: jax.Array, enable32: bool = True,
             ((et.etag & MG_BDY) != 0) & ~frozen22 & pair_ok_f
     else:
         pre22 = jnp.zeros(Efull, bool)
+    if vact is not None:
+        # narrow-path restriction (ops/active.py): both endpoints active
+        # keeps the cavity fully inside the sub-mesh
+        vok = vact[jnp.clip(et.ev[:, 0], 0, capP - 1)] & \
+            vact[jnp.clip(et.ev[:, 1], 0, capP - 1)]
+        pre32 = pre32 & vok
+        pre22 = pre22 & vok
+    if wwin is not None:
+        # spatial-window rotation (ops/active.py): see collapse_wave
+        wok = wwin[jnp.clip(et.ev[:, 0], 0, capP - 1)] & \
+            wwin[jnp.clip(et.ev[:, 1], 0, capP - 1)]
+        pre32 = pre32 & wok
+        pre22 = pre22 & wok
     pre = pre32 | pre22
     from .edges import wave_budget
     K = min(Efull, wave_budget(capT, budget_div))
+    defer = jnp.sum(pre.astype(jnp.int32)) > K
     # top-K worst shells without a full-width argsort
     _, sel = jax.lax.top_k(jnp.where(pre, -q_shell, -jnp.inf), K)
 
@@ -434,7 +452,7 @@ def swap_edges_wave(mesh: Mesh, met: jax.Array, enable32: bool = True,
     nsw = jnp.sum(win.astype(jnp.int32))
     out = dataclasses.replace(mesh, tet=tet, tmask=tmask, ftag=ftag,
                               fref=fref, etag=etag, nelem=mesh.nelem)
-    return SwapResult(out, nsw)
+    return SwapResult(out, nsw, defer)
 
 
 def swap32_wave(mesh: Mesh, met: jax.Array) -> SwapResult:
@@ -450,7 +468,8 @@ def swap22_wave(mesh: Mesh, met: jax.Array, flat_tol: float = 1e-5,
 
 
 def swap23_wave(mesh: Mesh, met: jax.Array,
-                budget_div: int = 8) -> SwapResult:
+                budget_div: int = 8,
+                wwin: jax.Array | None = None) -> SwapResult:
     """2-to-3 swap: interior faces whose two tets improve as an edge fan.
 
     Tets T1, T2 share interior face (p,q,r) with apexes a (in T1) and b (in
@@ -486,10 +505,15 @@ def swap23_wave(mesh: Mesh, met: jax.Array,
     arT = jnp.arange(capT)
     t2_full = nb_s[arT, fstar]
     cand_full = own[arT, fstar]
+    if wwin is not None:
+        # spatial-window rotation (ops/active.py): see collapse_wave
+        cand_full = cand_full & jnp.all(
+            wwin[jnp.clip(mesh.tet, 0, capP - 1)], axis=1)
     q_pair = jnp.minimum(q_tet, jnp.where(cand_full, q_tet[t2_full],
                                           jnp.inf))
     from .edges import wave_budget
     F = min(capT, wave_budget(capT, budget_div))
+    defer = jnp.sum(cand_full.astype(jnp.int32)) > F
     _, sel = jax.lax.top_k(jnp.where(cand_full, -q_pair, -jnp.inf), F)
     ar = jnp.arange(F)
     t1 = sel.astype(jnp.int32)
@@ -616,6 +640,6 @@ def swap23_wave(mesh: Mesh, met: jax.Array,
     out = dataclasses.replace(mesh, tet=tet, tmask=tmask, tref=tref,
                               ftag=ftag, etag=etag, fref=fref,
                               nelem=nelem.astype(jnp.int32))
-    return SwapResult(out, nsw)
+    return SwapResult(out, nsw, defer)
 
 

@@ -643,8 +643,10 @@ def distributed_adapt_multi(mesh: Mesh, met, n_shards: int,
     # device-compacted band/interface tables (parallel/migrate_dev.py);
     # any budget overflow falls back to the full-view oracle path below
     import os as _os
-    use_band = (mode != "graph"
-                and _os.environ.get("PARMMG_BAND_PATH", "1") != "0")
+    # both repartitioning modes ride the band path (graph mode since
+    # round 4: cluster graph from device-compacted tables,
+    # migrate_dev.graph_repartition_labels_band)
+    use_band = _os.environ.get("PARMMG_BAND_PATH", "1") != "0"
     glo_d = None
     shared_prev = None
     if use_band:
@@ -672,11 +674,18 @@ def distributed_adapt_multi(mesh: Mesh, met, n_shards: int,
             if glo_d is None:
                 glo_d = jnp.asarray(np.stack(glo).astype(np.int32))
             KN = max(256, stacked.vert.shape[1] // 2)
-            # int32 numbering on device (documented migrate_dev limit)
-            glo_d2, top_d, f_rows, f_gids, oke = extend_ids_device(
-                glo_d, stacked.vmask, jnp.asarray(top, jnp.int32),
-                KN=KN)
-            if bool(oke):
+            # int32 numbering on device (documented migrate_dev limit):
+            # the monotone session counter must not wrap — if this
+            # iteration could hand out ids past int31, take the host
+            # path (which re-derives a compact numbering) instead of
+            # silently aliasing device ids
+            ids_fit = top + n_shards * KN < 2 ** 31
+            oke = False
+            if ids_fit:
+                glo_d2, top_d, f_rows, f_gids, oke = extend_ids_device(
+                    glo_d, stacked.vmask, jnp.asarray(top, jnp.int32),
+                    KN=KN)
+            if ids_fit and bool(oke):
                 glo_d = glo_d2
                 top = int(top_d)
                 f_rows = np.asarray(f_rows)
@@ -689,7 +698,15 @@ def distributed_adapt_multi(mesh: Mesh, met, n_shards: int,
             else:               # fresh-id budget blown: host extend
                 vmask_h = np.asarray(stacked.vmask)
                 top = extend_global_ids_from_vmask(glo, vmask_h, top)
-                glo_d = jnp.asarray(np.stack(glo).astype(np.int32))
+                if top >= 2 ** 31:
+                    # the int32 device numbering can no longer represent
+                    # the session ids: permanently leave the band path
+                    # (the host path carries int64 ids) instead of
+                    # wrapping them on the next device cast
+                    use_band = False
+                    glo_d = None
+                else:
+                    glo_d = jnp.asarray(np.stack(glo).astype(np.int32))
         else:
             vmask_h = np.asarray(stacked.vmask)
             top = extend_global_ids_from_vmask(glo, vmask_h, top)
@@ -707,11 +724,33 @@ def distributed_adapt_multi(mesh: Mesh, met, n_shards: int,
             nmoved = 0
             band_done = False
             if use_band:
-                sizes = jnp.sum(stacked.tmask, axis=1, dtype=jnp.int32)
-                labels_d, depth_d = flood_labels(
-                    stacked, jnp.asarray(comms.node_idx),
-                    jnp.asarray(comms.nbr), sizes, n_shards,
-                    nlayers=ifc_layers)
+                from .migrate_dev import (repair_flood_labels,
+                                          graph_repartition_labels_band)
+                if mode == "graph":
+                    # cluster-graph rebalance from device tables (the
+                    # metis_pmmg.c:845-1550 gather-only-the-graph role);
+                    # depth 0 everywhere — the donor floor still bounds
+                    # per-shard departures, order within a shard is
+                    # immaterial for cluster moves
+                    labels_d = graph_repartition_labels_band(
+                        stacked, comms, n_shards, verbose=verbose)
+                    depth_d = jnp.zeros(stacked.tmask.shape, jnp.int32)
+                    if labels_d is None:
+                        labels_d = jnp.broadcast_to(
+                            jnp.arange(n_shards, dtype=jnp.int32)[:, None],
+                            stacked.tmask.shape)
+                else:
+                    sizes = jnp.sum(stacked.tmask, axis=1,
+                                    dtype=jnp.int32)
+                    labels_d, depth_d = flood_labels(
+                        stacked, jnp.asarray(comms.node_idx),
+                        jnp.asarray(comms.nbr), sizes, n_shards,
+                        nlayers=ifc_layers)
+                    # contiguity/reachability repair on the displaced
+                    # partition (moveinterfaces_pmmg.c:475-720 role)
+                    labels_d, _nfix = repair_flood_labels(
+                        stacked, labels_d, depth_d, n_shards,
+                        verbose=verbose)
                 res = band_migrate_iteration(
                     stacked, met_s, glo_d, glo, labels_d, depth_d,
                     shared_prev, n_shards, verbose=verbose)
@@ -776,12 +815,16 @@ def distributed_adapt_multi(mesh: Mesh, met, n_shards: int,
                     labels = enforce_ne_min(labels, views.tmask,
                                             n_shards)
                 else:
+                    from .migrate_dev import repair_flood_labels
                     sizes = jnp.asarray(
                         views.tmask.sum(axis=1).astype(np.int32))
                     labels_d, depth_d = flood_labels(
                         stacked, jnp.asarray(comms.node_idx),
                         jnp.asarray(comms.nbr), sizes, n_shards,
                         nlayers=ifc_layers)
+                    labels_d, _nfix = repair_flood_labels(
+                        stacked, labels_d, depth_d, n_shards,
+                        verbose=verbose)
                     labels = np.asarray(labels_d)
                     labels = enforce_ne_min(labels, views.tmask,
                                             n_shards,

@@ -575,15 +575,6 @@ def band_migrate_iteration(stacked: Mesh, met_s, glo_d,
     if not bool(okf):
         return None
 
-    # ---- host glo mirror sync (arrivals + liveness) ---------------------
-    arr_rows = np.asarray(info["arr_rows"])
-    arr_gids = np.asarray(info["arr_gids"])
-    vmask_h = np.asarray(stacked2.vmask)
-    for s in range(S):
-        m = arr_rows[s] >= 0
-        glo[s][arr_rows[s][m]] = arr_gids[s][m].astype(np.int64)
-        glo[s][~vmask_h[s]] = -1
-
     # ---- cross-shard face match -----------------------------------------
     keys = np.asarray(keys)
     slots = np.asarray(slots)
@@ -599,7 +590,27 @@ def band_migrate_iteration(stacked: Mesh, met_s, glo_d,
     SH = np.concatenate(sh)
     order = np.lexsort((K[:, 2], K[:, 1], K[:, 0]))
     Ks, SLs, SHs = K[order], SL[order], SH[order]
-    pair = np.concatenate([(Ks[1:] == Ks[:-1]).all(1), [False]])
+    eq = (Ks[1:] == Ks[:-1]).all(1)
+    if eq.size > 1 and bool(np.any(eq[1:] & eq[:-1])):
+        # a global-id triple exposed by 3+ shards (non-manifold parallel
+        # face): the consecutive-pair linking below would double-link the
+        # middle slot — fall back to the full-view path this iteration
+        # (the host oracle shares the 2-shard assumption but rebuilds
+        # interfaces from whole views, which stays consistent)
+        return None
+
+    # ---- host glo mirror sync (arrivals + liveness) ---------------------
+    # (after the pairing guard: a None return above must leave the host
+    # glo mirror untouched for the full-view fallback)
+    arr_rows = np.asarray(info["arr_rows"])
+    arr_gids = np.asarray(info["arr_gids"])
+    vmask_h = np.asarray(stacked2.vmask)
+    for s in range(S):
+        m = arr_rows[s] >= 0
+        glo[s][arr_rows[s][m]] = arr_gids[s][m].astype(np.int64)
+        glo[s][~vmask_h[s]] = -1
+
+    pair = np.concatenate([eq, [False]])
     iA = np.where(pair)[0]
     iB = iA + 1
     face_lists = [[[] for _ in range(S)] for _ in range(S)]
@@ -659,8 +670,16 @@ def band_migrate_iteration(stacked: Mesh, met_s, glo_d,
     comms = pad_comm_tables(node_lists, face_lists, owner, S)
 
     # ---- retag on device ------------------------------------------------
-    KF2 = max(1, max(len(x) for x in ifc_face_slots))
-    KN = max(1, max(len(x) for x in ifc_vert_rows))
+    # bucket the static shapes to the next power of two (floored) so the
+    # jitted retag program is reused across iterations instead of
+    # recompiling for every distinct interface size
+    def _bucket(n: int, floor: int = 256) -> int:
+        b = floor
+        while b < n:
+            b *= 2
+        return b
+    KF2 = _bucket(max(1, max(len(x) for x in ifc_face_slots)))
+    KN = _bucket(max(1, max(len(x) for x in ifc_vert_rows)))
     slots_d = np.full((S, KF2), capT * 4, np.int32)
     vrows_d = np.full((S, KN), capP, np.int32)
     for s in range(S):
@@ -759,3 +778,322 @@ def band_weld(stacked: Mesh, met_s, glo_d, glo: list[np.ndarray],
     out = dataclasses.replace(stacked, tet=tet_d, tmask=tmask_d,
                               vmask=vmask_d)
     return out, glo_d_out, ntot
+
+
+# ---------------------------------------------------------------------------
+# flood-label contiguity / reachability repair
+# ---------------------------------------------------------------------------
+# The advancing-front flood (migrate.flood_labels) propagates colors via
+# vertex priorities, so each color region is vertex-connected to its
+# seeds BY CONSTRUCTION — but priority ties between competing colors can
+# cut a region off its front (an unreachable moving blob), and two
+# fronts meeting can enclose an unflooded pocket of retained tets.  The
+# reference repairs exactly these on the displaced partition:
+# sub-blob merge (/root/reference/src/moveinterfaces_pmmg.c:475-626) and
+# destination reachability (:627-720).  Here both checks run on a
+# band-sized compacted probe (moving tets + their retained 1-ring), so
+# the host never touches O(mesh) state.
+
+def _flood_probe_one(tet, tmask, adja, label, depth, me, KB: int,
+                     capP: int):
+    capT = tet.shape[0]
+    moving = tmask & (label != me)
+    nbrc = jnp.clip(adja >> 2, 0, capT - 1)
+    has = (adja >= 0) & tmask[:, None]
+    nbr_mov = jnp.where(has, moving[nbrc], False)          # [T,4]
+    ring = tmask & ~moving & jnp.any(nbr_mov, axis=1)
+    band = moving | ring
+    cnt = jnp.sum(band, dtype=jnp.int32)
+    rows = jnp.nonzero(band, size=KB, fill_value=capT)[0].astype(jnp.int32)
+    rv = rows < capT
+    rc = jnp.clip(rows, 0, capT - 1)
+    # vertices held by a retained tet OUTSIDE the band: a ring component
+    # with no such vertex is an enclosed island
+    out_ret = tmask & ~band
+    vout = jnp.zeros(capP + 1, bool).at[
+        jnp.where(out_ret[:, None], tet, capP).reshape(-1)].set(
+        True, mode="drop")[:capP]
+    row_tet = jnp.where(rv[:, None], tet[rc], 0)
+    out_touch = jnp.any(vout[jnp.clip(row_tet, 0, capP - 1)],
+                        axis=1) & rv
+    return (cnt, rows,
+            jnp.where(rv, label[rc], -1),
+            jnp.where(rv, depth[rc], 0),
+            jnp.where(rv[:, None], row_tet, -1),
+            out_touch)
+
+
+@partial(jax.jit, static_argnames=("n_shards",))
+def flood_band_counts(stacked: Mesh, labels, n_shards: int):
+    """[S] int32: band size (moving + retained 1-ring) per shard."""
+    me = jnp.arange(n_shards, dtype=jnp.int32)
+
+    def one(tet, tm, adja, lab, m):
+        capT = tet.shape[0]
+        moving = tm & (lab != m)
+        nbrc = jnp.clip(adja >> 2, 0, capT - 1)
+        has = (adja >= 0) & tm[:, None]
+        ring = tm & ~moving & jnp.any(
+            jnp.where(has, moving[nbrc], False), axis=1)
+        return jnp.sum(moving | ring, dtype=jnp.int32)
+
+    return jax.vmap(one)(stacked.tet, stacked.tmask, stacked.adja,
+                         labels, me)
+
+
+@partial(jax.jit, static_argnames=("n_shards", "KB"))
+def flood_probe(stacked: Mesh, labels, depth, n_shards: int, KB: int):
+    me = jnp.arange(n_shards, dtype=jnp.int32)
+    capP = stacked.vert.shape[-2]
+    return jax.vmap(
+        lambda t, tm, a, l, d, m: _flood_probe_one(
+            t, tm, a, l, d, m, KB, capP)
+    )(stacked.tet, stacked.tmask, stacked.adja, labels, depth, me)
+
+
+@jax.jit
+def _apply_label_fixes(labels, rows, newlab):
+    def one(lab, r, nl):
+        capT = lab.shape[0]
+        tgt = jnp.where((r >= 0) & (r < capT) & (nl >= 0), r, capT)
+        return lab.at[tgt].set(jnp.where(nl >= 0, nl, 0), mode="drop")
+    return jax.vmap(one)(labels, rows, newlab)
+
+
+def _vertex_components(rtet: np.ndarray, sel: np.ndarray) -> np.ndarray:
+    """Connected components (by shared vertex) among the selected rows.
+
+    Returns [n] int component id (-1 on unselected rows).  Vectorized
+    min-label propagation over the (row, vertex) incidence — O(band *
+    diameter) numpy passes, no per-row Python (the band can reach tens
+    of thousands of rows on a big displaced partition)."""
+    n = rtet.shape[0]
+    rows = np.repeat(np.arange(n), rtet.shape[1])
+    verts = rtet.reshape(-1)
+    keep = (verts >= 0) & sel[rows]
+    rows, verts = rows[keep], verts[keep]
+    if not len(rows):
+        return np.full(n, -1, np.int64)
+    uv, vid = np.unique(verts, return_inverse=True)
+    comp = np.where(sel, np.arange(n), n).astype(np.int64)
+    for _ in range(64):                    # >> any real blob diameter
+        vmin = np.full(len(uv), n, np.int64)
+        np.minimum.at(vmin, vid, comp[rows])
+        new_c = comp.copy()
+        np.minimum.at(new_c, rows, vmin[vid])
+        if (new_c == comp).all():
+            break
+        comp = new_c
+    comp[~sel] = -1
+    return comp
+
+
+def repair_flood_labels(stacked: Mesh, labels_d, depth_d, n_shards: int,
+                        verbose: int = 0):
+    """Contiguity + reachability repair on the flood-displaced labels.
+
+    - an unreachable moving blob (a same-color vertex-connected
+      component with no depth-1 member, i.e. cut off its seed front by
+      color competition) reverts to its owner;
+    - an enclosed retained pocket (a ring component touching no retained
+      tet outside the band) joins the surrounding moving color (majority
+      among vertex-adjacent moving rows).
+
+    Returns (labels_d, nfixed).  Reference semantics:
+    moveinterfaces_pmmg.c:475-626 (fix_contiguity merge into a neighbor
+    color) and :627-720 (check_reachability revert)."""
+    cnts = np.asarray(flood_band_counts(stacked, labels_d, n_shards))
+    if int(cnts.max()) == 0:
+        return labels_d, 0
+    capT = stacked.tet.shape[1]
+    KB = 1024
+    while KB < int(cnts.max()):
+        KB *= 2
+    KB = min(KB, capT)
+    cnt, rows, lab, dep, rtet, out_touch = jax.device_get(
+        flood_probe(stacked, labels_d, depth_d, n_shards, KB))
+    new_lab = np.full((n_shards, KB), -1, np.int32)
+    nfixed = 0
+    for s in range(n_shards):
+        n = int(cnt[s])
+        if n == 0:
+            continue
+        lab_s = np.array(lab[s][:n])
+        dep_s = dep[s][:n]
+        rtet_s = rtet[s][:n]
+        touch_s = out_touch[s][:n]
+        fixed_s = np.zeros(n, bool)
+        # --- moving blobs: same-color components need a depth-1 seed ---
+        for c in np.unique(lab_s):
+            c = int(c)
+            if c == s or c < 0:
+                continue
+            selc = lab_s == c
+            comp = _vertex_components(rtet_s, selc)
+            for cid in np.unique(comp[selc]):
+                mem = comp == cid
+                if not (dep_s[mem] == 1).any():
+                    lab_s[mem] = s              # revert: unreachable
+                    fixed_s |= mem
+        # --- retained pockets: ring components with no outside anchor --
+        selr = lab_s == s
+        comp = _vertex_components(rtet_s, selr)
+        # vectorized vertex -> (component, moving-label) incidence for
+        # the anchored test + majority relabel (no per-row Python)
+        rows_i = np.repeat(np.arange(n), rtet_s.shape[1])
+        verts_i = rtet_s.reshape(-1)
+        vok = verts_i >= 0
+        rows_i, verts_i = rows_i[vok], verts_i[vok]
+        mov_i = (lab_s[rows_i] != s) & (lab_s[rows_i] >= 0)
+        for cid in np.unique(comp[selr]):
+            mem = comp == cid
+            if touch_s[mem].any():
+                continue                        # anchored to the interior
+            vset = np.unique(verts_i[mem[rows_i]])
+            nbr = mov_i & np.isin(verts_i, vset)
+            if not nbr.any():
+                continue
+            vals, freq = np.unique(lab_s[rows_i[nbr]],
+                                   return_counts=True)
+            lab_s[mem] = int(vals[np.argmax(freq)])
+            fixed_s |= mem
+        if fixed_s.any():
+            new_lab[s, :n][fixed_s] = lab_s[fixed_s]
+            nfixed += int(fixed_s.sum())
+    if nfixed == 0:
+        return labels_d, 0
+    if verbose >= 2:
+        print(f"  flood repair: relabeled {nfixed} band tets "
+              "(contiguity/reachability)")
+    labels_d = _apply_label_fixes(labels_d, jnp.asarray(rows),
+                                  jnp.asarray(new_lab))
+    return labels_d, nfixed
+
+
+# ---------------------------------------------------------------------------
+# graph-balancing labels from device-compacted tables (zero full pulls)
+# ---------------------------------------------------------------------------
+# The reference's graph mode gathers ONLY the group graph to rank 0 and
+# runs METIS on it (/root/reference/src/metis_pmmg.c:845-1550).  Round 3
+# matched the algorithm (morton clusters as redistribution groups +
+# weighted KL/FM on the cluster graph) but still pulled full shard views
+# to build it.  Here the cluster assignment, cluster weights, the
+# intra-shard cluster adjacency (via the maintained adja — no face
+# sort), and the interface-slot cluster ids are computed ON DEVICE and
+# only O(S*G^2 + interface) tables reach the host.
+
+@partial(jax.jit, static_argnames=("n_shards", "G"))
+def graph_probe(stacked: Mesh, face_idx, n_shards: int, G: int):
+    """Per shard: morton cluster id per live tet [S, capT], live count
+    [S], cluster weights [S, G], intra-shard cluster-pair face counts
+    [S, G*G], and the cluster id at each comm face slot [S, K, I]."""
+    capP = stacked.vert.shape[1]
+
+    def one(tet, tm, adja, vert, fidx):
+        from ..ops.edges import morton_codes
+        capT = tet.shape[0]
+        cent = jnp.mean(vert[jnp.clip(tet, 0, capP - 1)], axis=1)
+        code = morton_codes(cent, tm, bits=10)
+        key = jnp.where(tm, code, _I32MAX)
+        order = jnp.argsort(key)
+        rank = jnp.zeros(capT, jnp.int32).at[order].set(
+            jnp.arange(capT, dtype=jnp.int32))
+        nlive = jnp.sum(tm, dtype=jnp.int32)
+        # equal-count chunks along the curve = the redistribution groups
+        clus = jnp.clip((rank * G) // jnp.maximum(nlive, 1), 0, G - 1)
+        clus = jnp.where(tm, clus, 0).astype(jnp.int32)
+        cw = jnp.zeros(G, jnp.int32).at[
+            jnp.where(tm, clus, G)].add(1, mode="drop")
+        # intra-shard cluster adjacency from adja (cross-shard faces are
+        # adja=-1 at the frozen interface and counted via the comms)
+        nbrt = jnp.clip(adja >> 2, 0, capT - 1)
+        tid = jnp.arange(capT, dtype=jnp.int32)[:, None]
+        own = (adja >= 0) & tm[:, None] & (tid < (adja >> 2)) & \
+            tm[nbrt]
+        ci = jnp.broadcast_to(clus[:, None], (capT, 4))
+        cj = clus[nbrt]
+        cross = own & (ci != cj)
+        pk = jnp.where(cross, jnp.minimum(ci, cj) * G +
+                       jnp.maximum(ci, cj), G * G)
+        pcnt = jnp.zeros(G * G, jnp.int32).at[pk.reshape(-1)].add(
+            1, mode="drop")
+        # cluster at each interface face slot (order matches both sides)
+        ft = jnp.clip(fidx // 4, 0, capT - 1)
+        cif = jnp.where(fidx >= 0, clus[ft], -1)
+        return clus, nlive, cw, pcnt, cif
+
+    return jax.vmap(one)(stacked.tet, stacked.tmask, stacked.adja,
+                         stacked.vert, face_idx)
+
+
+@partial(jax.jit, static_argnames=("n_shards",))
+def _labels_from_parts(clus, tmask, new_part, n_shards: int):
+    me = jnp.arange(n_shards, dtype=jnp.int32)
+    G = new_part.shape[0] // n_shards
+
+    def one(c, tm, m):
+        lab = new_part[m * G + c]
+        return jnp.where(tm, lab, m).astype(jnp.int32)
+
+    return jax.vmap(one)(clus, tmask, me)
+
+
+def graph_repartition_labels_band(stacked: Mesh, comms, n_shards: int,
+                                  clusters_per_shard: int = 8,
+                                  verbose: int = 0):
+    """Device-resident graph-balancing labels: [S, capT] target shard
+    per tet (device array), from O(S*G^2 + interface) host tables only.
+
+    Same algorithm as migrate.graph_repartition_labels (morton clusters
+    + weighted KL/FM on the cluster graph, the metis_pmmg.c:845-1550
+    gather-only-the-graph role) without the full views pull."""
+    from .partition import refine_partition
+    S, G = n_shards, clusters_per_shard
+    clus, nlive, cw, pcnt, cif = jax.device_get(graph_probe(
+        stacked, jnp.asarray(comms.face_idx), S, G))
+    nclu = S * G
+    pi, pj, w = [], [], []
+    for s in range(S):
+        mat = pcnt[s]
+        nz = np.where(mat > 0)[0]
+        if len(nz):
+            pi.append(s * G + nz // G)
+            pj.append(s * G + nz % G)
+            w.append(mat[nz].astype(float))
+    # interface edges: the comm tables are ordered identically on both
+    # sides of a pair, so zipping the two shards' slot-cluster rows
+    # gives the cross-shard cluster pairs directly
+    nbr = comms.nbr
+    fcnt = comms.face_cnt
+    for s in range(S):
+        for k in range(nbr.shape[1]):
+            b = int(nbr[s, k])
+            if b <= s:
+                continue
+            n_items = int(fcnt[s, k])
+            if n_items == 0:
+                continue
+            kb = int(np.where(nbr[b] == s)[0][0])
+            ca = cif[s, k, :n_items]
+            cb = cif[b, kb, :n_items]
+            okm = (ca >= 0) & (cb >= 0)
+            key = (s * G + ca[okm]).astype(np.int64) * nclu + \
+                (b * G + cb[okm])
+            uk, cnts = np.unique(key, return_counts=True)
+            pi.append((uk // nclu).astype(np.int64))
+            pj.append((uk % nclu).astype(np.int64))
+            w.append(cnts.astype(float))
+    if not pi:
+        return None
+    pi = np.concatenate(pi)
+    pj = np.concatenate(pj)
+    w = np.concatenate(w)
+    init = np.repeat(np.arange(S, dtype=np.int32), G)
+    new_part = refine_partition(init, S, (pi, pj), w,
+                                elem_w=cw.reshape(-1).astype(float),
+                                npasses=5)
+    nmv = int((new_part != init).sum())
+    if verbose >= 2:
+        print(f"  graph band labels: {nmv}/{nclu} clusters reassigned")
+    return _labels_from_parts(jnp.asarray(clus), stacked.tmask,
+                              jnp.asarray(new_part), S)

@@ -123,6 +123,26 @@ def analytic_iso_metric(vert: np.ndarray, kind: str = "uniform",
     raise ValueError(kind)
 
 
+def analytic_ani_metric(vert: np.ndarray, kind: str = "shock",
+                        h: float = 0.1, h_tan: float = 0.45):
+    """Packed anisotropic test metrics [n, 6] (Mmg packing
+    m11,m12,m13,m22,m23,m33): ``shock`` = planar-shock tensor — tight
+    spacing ACROSS the plane x=0.5 (h scaled by distance, like the iso
+    shock), loose ``h_tan`` along the tangential directions.  The
+    aniso-torus analogue of the reference CI matrix
+    (cmake/testing/pmmg_tests.cmake:31-38)."""
+    n = vert.shape[0]
+    if kind == "shock":
+        d = np.abs(vert[:, 0] - 0.5)
+        hx = h * (0.2 + 4.0 * d)
+        m = np.zeros((n, 6))
+        m[:, 0] = 1.0 / hx ** 2
+        m[:, 3] = 1.0 / h_tan ** 2
+        m[:, 5] = 1.0 / h_tan ** 2
+        return m
+    raise ValueError(kind)
+
+
 def cylinder_mesh(n: int = 6, r: float = 0.5):
     """Solid cylinder (radius r, height 1, axis z): cube mesh with the
     (x, y) square cross-section mapped onto the disk.  The cap rims are

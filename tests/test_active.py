@@ -1,0 +1,158 @@
+"""Active-scoped narrow adaptation (ops/active.py) — the worklist path.
+
+The reference's remesher is worklist-driven (``MMG5_mmg3d1_delone``
+cascades over affected entities, libparmmg1.c:737); ops/active.py is the
+batched equivalent: cycles self-select between full-width waves and an
+[A]-row compacted sub-mesh over the dirty regions.  These tests pin the
+invariants that make the narrow branch exact:
+
+- untouched regions are bit-identical across a narrow cycle;
+- the mesh stays conforming (adjacency oracle) and volume-preserving;
+- the auto path converges to the same quality class as the full path;
+- the worklist state machine (okflag/defer) actually engages narrow.
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from parmmg_tpu.core.mesh import make_mesh, tet_volumes
+from parmmg_tpu.ops.active import (adapt_cycles_auto, closure_active,
+                                   dirty_from_diff, narrow_rows)
+from parmmg_tpu.ops.adapt import adapt_cycles_fused, adapt_mesh
+from parmmg_tpu.ops.adjacency import check_adjacency
+from parmmg_tpu.ops.analysis import analyze_mesh
+from parmmg_tpu.ops.quality import tet_quality
+from parmmg_tpu.utils.fixtures import cube_mesh, analytic_iso_metric
+
+
+def _setup(n=5, capmul=6):
+    vert, tet = cube_mesh(n)
+    mesh = make_mesh(vert, tet, capP=capmul * len(vert),
+                     capT=capmul * len(tet))
+    mesh = analyze_mesh(mesh).mesh
+    h = analytic_iso_metric(vert, "shock", h=1.5 / n)
+    met = jnp.zeros(mesh.capP).at[: len(h)].set(
+        jnp.asarray(h)).at[len(h):].set(1.0)
+    return mesh, met
+
+
+def _run_auto(mesh, met, blocks=5, nper=3):
+    dirty = jnp.zeros(mesh.capP, bool)
+    ok = jnp.asarray(False)
+    rows = []
+    for b in range(blocks):
+        flags = tuple((nper * b + c) % 3 == 2 for c in range(nper))
+        mesh, met, dirty, ok, counts = adapt_cycles_auto(
+            mesh, met, dirty, ok, jnp.asarray(nper * b, jnp.int32),
+            swap_flags=flags)
+        rows.extend(np.asarray(counts))
+    return mesh, met, dirty, ok, np.asarray(rows)
+
+
+def test_auto_engages_narrow_and_stays_conforming():
+    mesh, met = _setup()
+    vol0 = float(np.asarray(tet_volumes(mesh))[np.asarray(mesh.tmask)]
+                 .sum())
+    mesh, met, dirty, ok, rows = _run_auto(mesh, met, blocks=5)
+    # the worklist must engage (narrow marker, column 7) after the
+    # seeding full cycles
+    assert rows[:, 7].sum() >= 3, rows
+    assert check_adjacency(mesh) == {"asymmetric": 0, "face_mismatch": 0}
+    vols = np.asarray(tet_volumes(mesh))[np.asarray(mesh.tmask)]
+    assert (vols > 0).all()
+    assert np.isclose(vols.sum(), vol0, rtol=1e-5)
+
+
+def test_narrow_leaves_untouched_regions_bit_identical():
+    mesh, met = _setup()
+    # seed the worklist with full cycles
+    dirty = jnp.zeros(mesh.capP, bool)
+    ok = jnp.asarray(False)
+    mesh, met, dirty, ok, counts = adapt_cycles_auto(
+        mesh, met, dirty, ok, jnp.asarray(0, jnp.int32),
+        swap_flags=(False, False, False))
+    pre = jax.tree.map(jnp.copy, mesh)
+    pre_dirty = jnp.copy(dirty)
+    mesh2, met2, dirty2, ok2, counts2 = adapt_cycles_auto(
+        mesh, met, dirty, ok, jnp.asarray(3, jnp.int32),
+        swap_flags=(False,), full_flags=(False,), final_rebuild=False)
+    assert int(np.asarray(counts2)[0][7]) == 1   # ran narrow
+    # rows outside the active set must be untouched
+    d2, active = jax.jit(closure_active)(pre, pre_dirty)
+    act = np.asarray(active)
+    inact = ~act & np.asarray(pre.tmask)
+    for name in ("tet", "tref", "ftag", "fref", "etag"):
+        a = np.asarray(getattr(pre, name))[inact]
+        b = np.asarray(getattr(mesh2, name))[inact]
+        assert (a == b).all(), name
+    assert np.asarray(pre.tmask)[inact].all()
+    assert np.asarray(mesh2.tmask)[inact].all()
+    # vertices not in the closure keep position/tags
+    d2n = np.asarray(d2)
+    far = ~d2n & np.asarray(pre.vmask)
+    assert (np.asarray(pre.vert)[far] == np.asarray(mesh2.vert)[far]).all()
+    assert (np.asarray(pre.vtag)[far] == np.asarray(mesh2.vtag)[far]).all()
+
+
+def test_auto_matches_full_quality():
+    mesh, met = _setup(n=4)
+    mesh_f, met_f = jax.tree.map(jnp.copy, mesh), jnp.copy(met)
+    # auto path
+    mesh_a, _, _, _, rows = _run_auto(mesh, met, blocks=6)
+    # full-only path, same cadence
+    for b in range(6):
+        flags = tuple((3 * b + c) % 3 == 2 for c in range(3))
+        mesh_f, met_f, _ = adapt_cycles_fused(
+            mesh_f, met_f, jnp.asarray(3 * b, jnp.int32),
+            swap_flags=flags)
+    qa = np.asarray(tet_quality(mesh_a))[np.asarray(mesh_a.tmask)]
+    qf = np.asarray(tet_quality(mesh_f))[np.asarray(mesh_f.tmask)]
+    # same quality class (the independent sets differ in tie-breaks, so
+    # bit-equality is not expected)
+    assert qa.min() > 0.5 * qf.min() - 1e-3
+    assert abs(qa.mean() - qf.mean()) < 0.1
+    na, nf = len(qa), len(qf)
+    assert abs(na - nf) < 0.2 * max(na, nf)
+
+
+def test_adapt_mesh_auto_converges():
+    # the host driver path: auto blocks + quiet/wide-check machinery +
+    # polish; must converge to the standard quality gates
+    mesh, met = _setup(n=4)
+    m2, k2, st = adapt_mesh(mesh, met, max_cycles=40, cycle_block=3)
+    assert check_adjacency(m2) == {"asymmetric": 0, "face_mismatch": 0}
+    q = np.asarray(tet_quality(m2))[np.asarray(m2.tmask)]
+    assert q.min() > 0.05
+    assert st.nsplit > 0
+
+
+def test_narrow_discard_on_tight_capacity():
+    # a mesh with nearly no free tet slots: the narrow branch must
+    # either run full (okflag seeding) or discard cleanly — never
+    # corrupt.  capmul=2 leaves little allocation room at refinement.
+    mesh, met = _setup(n=3, capmul=2)
+    vol0 = float(np.asarray(tet_volumes(mesh))[np.asarray(mesh.tmask)]
+                 .sum())
+    mesh, met, dirty, ok, rows = _run_auto(mesh, met, blocks=4)
+    assert check_adjacency(mesh) == {"asymmetric": 0, "face_mismatch": 0}
+    vols = np.asarray(tet_volumes(mesh))[np.asarray(mesh.tmask)]
+    assert (vols > 0).all()
+    assert np.isclose(vols.sum(), vol0, rtol=1e-5)
+
+
+def test_dirty_from_diff_detects_each_field():
+    mesh, met = _setup(n=2)
+    base = jax.tree.map(jnp.copy, mesh)
+    # a vertex move dirties exactly that vertex (plus nothing else)
+    moved = base.vert.at[5, 0].add(1e-3)
+    import dataclasses
+    m2 = dataclasses.replace(base, vert=moved)
+    d = np.asarray(jax.jit(dirty_from_diff)(base, m2))
+    assert d[5] and d.sum() == 1
+    # a tet rewrite dirties its old and new vertices
+    t0 = np.asarray(base.tet[0])
+    newrow = jnp.asarray([t0[0], t0[1], t0[2], int(t0[3]) + 1])
+    m3 = dataclasses.replace(base, tet=base.tet.at[0].set(newrow))
+    d3 = np.asarray(jax.jit(dirty_from_diff)(base, m3))
+    assert d3[t0].all() and d3[int(t0[3]) + 1]

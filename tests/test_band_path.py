@@ -178,3 +178,36 @@ def test_band_and_full_paths_agree_statistically():
         results[flag] = int(np.asarray(out.tmask).sum())
     a, b = results["1"], results["0"]
     assert abs(a - b) <= 0.3 * max(a, b)
+
+
+def test_graph_mode_band_path_no_full_pull():
+    """Graph mode must also run without a full views pull: the cluster
+    graph comes from device-compacted tables
+    (migrate_dev.graph_repartition_labels_band, the metis_pmmg.c
+    gather-only-the-graph role)."""
+    calls = {"n": 0}
+    orig = migrate.pull_views
+
+    def counting(*a, **k):
+        calls["n"] += 1
+        return orig(*a, **k)
+
+    migrate.pull_views = counting
+    try:
+        vert, tet = cube_mesh(3)
+        m = make_mesh(vert, tet, capP=4 * len(vert), capT=4 * len(tet))
+        m = analyze_mesh(m).mesh
+        met = jnp.full(m.capP, 0.3, m.vert.dtype)
+        out, met2, part = dist.distributed_adapt_multi(
+            m, met, 4, niter=3, cycles=3, mode="graph")
+    finally:
+        migrate.pull_views = orig
+    assert calls["n"] == 0, \
+        "graph mode must not pull full shard views between iterations"
+    out = build_adjacency(out)
+    assert check_adjacency(out) == {"asymmetric": 0, "face_mismatch": 0}
+    vols = np.asarray(tet_volumes(out))[np.asarray(out.tmask)]
+    assert (vols > 0).all()
+    assert np.isclose(vols.sum(), 1.0, rtol=1e-4)
+    q = np.asarray(tet_quality(out, met2))[np.asarray(out.tmask)]
+    assert q.min() > 0.02
