@@ -94,7 +94,11 @@ def main() -> None:
     bdiv = int(os.environ.get("BENCH_BUDGET_DIV", "8"))  # wave top-K div
 
     vert, tet = cube_mesh(n)
-    mesh = make_mesh(vert, tet, capP=3 * len(vert), capT=3 * len(tet))
+    # 4x capacity: the adapted shock cube peaks near 3x the input tets,
+    # and a capacity-saturated mesh silently capacity-drops residual
+    # split winners every cycle (overflow flag permanently set), which
+    # both truncates the workload and vetoes the worklist fast path
+    mesh = make_mesh(vert, tet, capP=4 * len(vert), capT=4 * len(tet))
     mesh = analyze_mesh(mesh).mesh
     h = analytic_iso_metric(vert, "shock", h=1.5 / n)
     met = jnp.zeros(mesh.capP, mesh.vert.dtype).at[: len(h)].set(
@@ -164,11 +168,12 @@ def main() -> None:
         if os.environ.get("BENCH_DEBUG", "") == "1":
             for r in cs:
                 nact = int(r[8]) if len(r) > 8 else -1
+                oki = int(r[9]) if len(r) > 9 else -1
                 print(f"bench:   cycle counts split={int(r[0]):6d} "
                       f"col={int(r[1]):6d} swap={int(r[2]):6d} "
                       f"move={int(r[3]):6d} live={int(r[5]):6d} "
                       f"defer={int(r[6])} narrow={int(r[7])} "
-                      f"nact={nact}", file=sys.stderr)
+                      f"nact={nact} ok={oki}", file=sys.stderr)
         # tets examined this block = sum over cycles of live-at-entry
         entries = [prev_live] + [int(r[5]) for r in cs[:-1]]
         live.append(int(np.sum(entries)))
@@ -195,14 +200,20 @@ def main() -> None:
     from parmmg_tpu.ops.adapt import sliver_polish
     from parmmg_tpu.ops.repair import repair_mesh
 
-    def _quality_tail(mm, kk, wave0):
+    def _quality_tail(mm, kk, wave0, use_met=False):
         for w in range(6):
             mm, pc = sliver_polish(mm, kk,
                                    jnp.asarray(wave0 + w, jnp.int32))
-            if int(np.asarray(pc)[0]) == 0 and                     int(np.asarray(pc)[1]) == 0:
+            pcn = np.asarray(pc)
+            if int(pcn[0]) == 0 and int(pcn[1]) == 0:
                 break
         mm, _ = repair_mesh(mm, kk)
-        qq = np.asarray(tet_quality(mm))
+        # iso reports Euclidean quality (the rounds-1..3 protocol, the
+        # MMG5_caltet_iso convention); ANISO reports METRIC quality —
+        # in an anisotropic metric the flattened elements are the
+        # target shape and their Euclidean quality is meaningless
+        qq = np.asarray(tet_quality(mm, kk) if use_met
+                        else tet_quality(mm))
         tmm = np.asarray(mm.tmask)
         return (mm, int(tmm.sum()),
                 float(qq[tmm].min()) if tmm.any() else 0.0,
@@ -247,7 +258,8 @@ def main() -> None:
             tm_a += time.perf_counter() - t0
             lv_a += prev_a + int(np.sum(cs_a[:-1, 5]))
             prev_a = int(cs_a[-1, 5])
-        ma, nta, qmin_a, qmean_a = _quality_tail(ma, ka_, 200)
+        ma, nta, qmin_a, qmean_a = _quality_tail(ma, ka_, 200,
+                                                 use_met=True)
         aniso = {"mtets_per_sec": round(lv_a / tm_a / 1e6, 4),
                  "ntets_final": nta,
                  "qmin": round(qmin_a, 4),

@@ -64,7 +64,7 @@ from ..core.mesh import Mesh
 from .adapt import adapt_cycle_impl
 from .adjacency import build_adjacency
 
-NARROW_DIV = 6          # A = max(NARROW_MIN, capT // NARROW_DIV)
+NARROW_DIV = 4          # A = max(NARROW_MIN, capT // NARROW_DIV)
 NARROW_MIN = 8192
 # fraction of A reserved for rows ALLOCATED by splits/swaps inside the
 # narrow cycle; the active set itself may only fill A - A//4
@@ -254,14 +254,16 @@ def auto_cycle(mesh: Mesh, met, pending, okflag, wave, A: int,
             counts2 = counts.at[4].set(0).at[5].set(
                 jnp.sum(mesh2.tmask, dtype=jnp.int32)).at[6].set(
                 bad.astype(jnp.int32)).at[7].set(1)
-            counts2 = jnp.concatenate([counts2, n_act[None]])
+            counts2 = jnp.concatenate(
+                [counts2, n_act[None], okflag.astype(jnp.int32)[None]])
             return mesh2, met2, _pending_next(dn), ~bad, counts2
 
         def _discard(_):
             counts2 = jnp.zeros(8, jnp.int32).at[5].set(
                 jnp.sum(mesh.tmask, dtype=jnp.int32)).at[6].set(
                 1).at[7].set(1)
-            counts2 = jnp.concatenate([counts2, n_act[None]])
+            counts2 = jnp.concatenate(
+                [counts2, n_act[None], okflag.astype(jnp.int32)[None]])
             return mesh, met, pending, jnp.zeros((), bool), counts2
 
         return jax.lax.cond(~alloc_bad, _apply, _discard, None)
@@ -283,7 +285,8 @@ def auto_cycle(mesh: Mesh, met, pending, okflag, wave, A: int,
         # module docstring.
         topo = counts[0] + counts[1] + counts[2]
         ok = (counts[4] == 0) & (topo < 512)
-        counts = jnp.concatenate([counts, n_act[None]])
+        counts = jnp.concatenate(
+            [counts, n_act[None], okflag.astype(jnp.int32)[None]])
         return mesh2, met2, _pending_next(dn), ok, counts
 
     return jax.lax.cond(can_narrow, _nar, _full, None)

@@ -47,7 +47,8 @@ def grouped_adapt_pass(mesh: Mesh, met, ngroups: int, cycles: int = 12,
                        part: np.ndarray | None = None,
                        verbose: int = 0, stats=None,
                        noinsert: bool = False, noswap: bool = False,
-                       nomove: bool = False, hausd: float | None = None):
+                       nomove: bool = False, hausd: float | None = None,
+                       polish: bool = False):
     """One outer pass: split into groups, run adapt cycles with lax.map
     over the group axis, merge.  Returns (mesh, met, part_of_merged).
 
@@ -129,6 +130,37 @@ def grouped_adapt_pass(mesh: Mesh, met, ngroups: int, cycles: int = 12,
                int(cs[i][0]) + int(cs[i][1]) + int(cs[i][2]) == 0
                for i in range(nblk)):
             break
+    if polish and not (noinsert and noswap and nomove):
+        # grouped bad-element pass: sliver_polish per group under the
+        # same lax.map regime (seams stay frozen; the outer-iteration
+        # displacement exposes them to a later pass).  This is what
+        # makes a >=1M-tet run report a REAL post-tail min quality
+        # without a whole-mesh-width program (which does not compile
+        # through the TPU tunnel at that width).
+        from ..ops.adapt import sliver_polish_impl
+
+        @jax.jit
+        def polish_block(stacked, met_s, wave):
+            def body(args):
+                m, k, w = args
+                m, cnt = sliver_polish_impl(
+                    m, k, w, do_collapse=not noinsert,
+                    do_swap=not noswap, do_smooth=not nomove,
+                    hausd=hausd)
+                return m, k, cnt
+            waves = jnp.full(ngroups, wave, jnp.int32)
+            m, k, cnt = jax.lax.map(body, (stacked, met_s, waves))
+            return m, k, cnt
+
+        for w in range(4):
+            stacked, met_s, cnt = polish_block(
+                stacked, met_s, jnp.asarray(2000 + w, jnp.int32))
+            tot = np.asarray(cnt).sum(axis=0)
+            if verbose >= 2:
+                print(f"  grp polish {w}: collapse {int(tot[0])} "
+                      f"swap {int(tot[1])} move {int(tot[2])}")
+            if int(tot[0]) == 0 and int(tot[1]) == 0:
+                break
     return merge_shards(stacked, met_s, return_part=True)
 
 

@@ -1049,8 +1049,23 @@ def graph_repartition_labels_band(stacked: Mesh, comms, n_shards: int,
     gather-only-the-graph role) without the full views pull."""
     from .partition import refine_partition
     S, G = n_shards, clusters_per_shard
+    # bucket the comm-table pad shape to the next power of two: the
+    # tables are rebuilt with exact sizes every rebalance iteration and
+    # an exact-shape jit would recompile graph_probe each time (the
+    # same recompile class the retag KF2/KN bucketing fixes)
+    fi = comms.face_idx
+    If = 256
+    while If < fi.shape[2]:
+        If *= 2
+    Kn = 2
+    while Kn < fi.shape[1]:
+        Kn *= 2
+    if (Kn, If) != fi.shape[1:]:
+        fi2 = np.full((fi.shape[0], Kn, If), -1, fi.dtype)
+        fi2[:, :fi.shape[1], :fi.shape[2]] = fi
+        fi = fi2
     clus, nlive, cw, pcnt, cif = jax.device_get(graph_probe(
-        stacked, jnp.asarray(comms.face_idx), S, G))
+        stacked, jnp.asarray(fi), S, G))
     nclu = S * G
     pi, pj, w = [], [], []
     for s in range(S):
