@@ -92,13 +92,16 @@ def main() -> None:
     cycles = int(os.environ.get("BENCH_CYCLES", "9"))
     block = int(os.environ.get("BENCH_BLOCK", "9"))   # fused cycles/dispatch
     bdiv = int(os.environ.get("BENCH_BUDGET_DIV", "8"))  # wave top-K div
+    cap = int(os.environ.get("BENCH_CAP", "8"))       # capacity factor
 
     vert, tet = cube_mesh(n)
-    # 4x capacity: the adapted shock cube peaks near 3x the input tets,
-    # and a capacity-saturated mesh silently capacity-drops residual
-    # split winners every cycle (overflow flag permanently set), which
-    # both truncates the workload and vetoes the worklist fast path
-    mesh = make_mesh(vert, tet, capP=4 * len(vert), capT=4 * len(tet))
+    # capacity: midpoint bisection against LLONG=sqrt(2)/LSHRT=1/sqrt(2)
+    # equilibrates with edges at ~0.7-1.0 of target, i.e. ~2-2.5x the
+    # ideal-tet count — ~6.3x the initial tets on this fixture.  A
+    # capacity-saturated mesh capacity-drops residual split winners
+    # every cycle (overflow flag permanently set), which both truncates
+    # the workload and vetoes the worklist fast path
+    mesh = make_mesh(vert, tet, capP=cap * len(vert), capT=cap * len(tet))
     mesh = analyze_mesh(mesh).mesh
     h = analytic_iso_metric(vert, "shock", h=1.5 / n)
     met = jnp.zeros(mesh.capP, mesh.vert.dtype).at[: len(h)].set(
@@ -171,7 +174,8 @@ def main() -> None:
                 oki = int(r[9]) if len(r) > 9 else -1
                 print(f"bench:   cycle counts split={int(r[0]):6d} "
                       f"col={int(r[1]):6d} swap={int(r[2]):6d} "
-                      f"move={int(r[3]):6d} live={int(r[5]):6d} "
+                      f"move={int(r[3]):6d} ovf={int(r[4])} "
+                      f"live={int(r[5]):6d} "
                       f"defer={int(r[6])} narrow={int(r[7])} "
                       f"nact={nact} ok={oki}", file=sys.stderr)
         # tets examined this block = sum over cycles of live-at-entry

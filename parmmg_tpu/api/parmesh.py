@@ -467,6 +467,13 @@ class ParMesh:
             mesh = self._apply_user_triangles(mesh)
         if self.na_:
             mesh = self._apply_user_edges(mesh)
+            # stage the refs for edge-kind local parameters (the core
+            # mesh keeps edge TAGS per tet slot, not refs — parsop edge
+            # locals resolve against the user list, driver.py
+            # apply_local_params typ 3)
+            self.info._user_edges = (
+                np.asarray(self.edge[: self.na_], np.int64) - 1,
+                np.asarray(self.edgeref[: self.na_], np.int32))
 
         # metric
         cap = mesh.capP

@@ -108,10 +108,21 @@ def main(argv=None) -> int:
     t0 = time.perf_counter()
     pm = ParMesh()
     inp = Path(args.inp)
-    if inp.suffix not in (".mesh", ".meshb"):
-        inp = inp.with_suffix(".mesh")
-
-    distributed_in = not inp.exists() and probe_distributed(inp, 0)
+    vtu_met = vtu_fields = None
+    if inp.suffix == ".vtu":
+        # centralized VTK input (PMMG_loadVtuMesh_centralized role,
+        # inoutcpp_pmmg.cpp:44); point fields named metric/sol become
+        # the metric unless -sol overrides
+        from .io.vtk import read_vtu_medit
+        if not inp.exists():
+            print(f"cannot open {inp}", file=sys.stderr)
+            return 1
+        m, vtu_met, vtu_fields = read_vtu_medit(inp)
+        distributed_in = False
+    else:
+        if inp.suffix not in (".mesh", ".meshb"):
+            inp = inp.with_suffix(".mesh")
+        distributed_in = not inp.exists() and probe_distributed(inp, 0)
     if distributed_in:
         # reassemble shards (the centralized entry of a distributed
         # checkpoint; parmmg.c's probe order reversed but equivalent)
@@ -125,6 +136,8 @@ def main(argv=None) -> int:
         # caller's decomposition (libparmmg.c:206-329 semantics) when the
         # device count matches the shard count
         pm._in_part = getattr(m, "src_part", None)
+    elif inp.suffix == ".vtu":
+        pass                                  # loaded above
     elif inp.exists():
         m = medit.read_mesh(inp)
     else:
@@ -154,6 +167,15 @@ def main(argv=None) -> int:
             pm.set_tensor_mets(vals.reshape(len(m.vert), 6))
         else:
             pm.set_scalar_mets(vals.reshape(len(m.vert)))
+    elif vtu_met is not None:
+        # metric carried in the VTU point data (the VTK-solution ingest
+        # of the reference's loadVtu path)
+        if vtu_met.ndim == 2 and vtu_met.shape[1] == 6:
+            pm.set_met_size(3, len(m.vert))
+            pm.set_tensor_mets(vtu_met)
+        else:
+            pm.set_met_size(1, len(m.vert))
+            pm.set_scalar_mets(np.asarray(vtu_met).reshape(len(m.vert)))
     if args.field:
         vals, types = medit.read_sol(args.field)
         pm.set_sols_at_vertices_size(len(types), types)
@@ -248,10 +270,13 @@ def _parse_parfile(path):
         <ref> <Triangle|Vertex|...> <hmin> <hmax> <hausd>
 
     Returns [(typ, ref, hmin, hmax, hausd)]: typ 1 = triangles (surface
-    reference patch), typ 2 = tetrahedra (volume sub-domain by tref);
-    other entity types warn and are skipped."""
+    reference patch), typ 2 = tetrahedra (volume sub-domain by tref),
+    typ 3 = edges (user edge list by ref), typ 0 = vertices (by point
+    ref); other entity types warn and are skipped."""
     typ_map = {"triangle": 1, "triangles": 1,
-               "tetrahedron": 2, "tetrahedra": 2, "tetrahedrons": 2}
+               "tetrahedron": 2, "tetrahedra": 2, "tetrahedrons": 2,
+               "edge": 3, "edges": 3, "ridge": 3,
+               "vertex": 0, "vertices": 0}
     out = []
     lines = [ln.strip() for ln in path.read_text().splitlines()
              if ln.strip() and not ln.strip().startswith("#")]

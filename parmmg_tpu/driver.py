@@ -74,12 +74,15 @@ def build_metric(mesh: Mesh, met, info):
 
 def apply_local_params(mesh: Mesh, met, info):
     """Per-reference size bounds (MMG3D_Set_localParameter / parsop file,
-    forwarded by the reference per group): vertices on boundary faces
+    forwarded by the reference per group): vertices of the entities
     carrying reference ``ref`` get their size clamped to the local
-    [hmin, hmax].  hausd (surface approximation distance) has no separate
-    role here — boundary faces are piecewise-linear and interface freezes
-    are tag-driven.  Iso: direct clamp; aniso: eigenvalue clamp of the
-    tensor (h = 1/sqrt(lambda))."""
+    [hmin, hmax].  Entity kinds: 1 = triangles (surface ref patch),
+    2 = tetrahedra (volume sub-domain), 3 = edges (user edge list,
+    staged in ``info._user_edges`` by the API build), 0 = vertices (by
+    point ref).  Per-entity hausd applies conservatively as the global
+    minimum (parmmg_run); local hausd relaxation above the global value
+    is not honored (documented divergence).  Iso: direct clamp; aniso:
+    eigenvalue clamp of the tensor (h = 1/sqrt(lambda))."""
     import jax.numpy as jnp
     from .core.constants import IDIR, MG_BDY
 
@@ -100,6 +103,17 @@ def apply_local_params(mesh: Mesh, met, info):
             sel_t = tmask & (tref == ref)
             vids = np.unique(tet[sel_t].reshape(-1)) if sel_t.any() \
                 else np.zeros(0, np.int64)
+        elif typ == 3:        # edge locals: user edges with this ref
+            ue, uref = getattr(info, "_user_edges", (None, None))
+            if ue is None:
+                continue
+            sel_e = uref == ref
+            vids = np.unique(ue[sel_e].reshape(-1)) if sel_e.any() \
+                else np.zeros(0, np.int64)
+        elif typ == 0:        # vertex locals: points with this ref
+            vm = np.asarray(mesh.vmask)
+            vrf = np.asarray(mesh.vref)
+            vids = np.where(vm & (vrf == ref))[0]
         else:
             continue
         if not len(vids):

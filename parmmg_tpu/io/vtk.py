@@ -1,8 +1,9 @@
-"""VTK XML output: .vtu per shard + .pvtu master (pure Python).
+"""VTK XML I/O: .vtu read + .vtu/.pvtu write (pure Python).
 
-Equivalent of the reference's VTK path (inoutcpp_pmmg.cpp:44-116,
-``PMMG_savePvtuMesh`` writing parallel .pvtu through Mmg's VTK templates)
-without the VTK library: we emit ascii VTU XML directly.
+Equivalent of the reference's VTK path (inoutcpp_pmmg.cpp:44-116:
+``PMMG_loadVtuMesh_centralized`` reading a centralized .vtu through
+Mmg's VTK templates, ``PMMG_savePvtuMesh`` writing parallel .pvtu)
+without the VTK library: ascii VTU XML emitted/parsed directly.
 """
 from __future__ import annotations
 
@@ -11,6 +12,8 @@ from pathlib import Path
 import numpy as np
 
 _VTK_TETRA = 10
+_VTK_TRIANGLE = 5
+_VTK_LINE = 3
 
 
 def write_vtu(path: str | Path, vert: np.ndarray, tet: np.ndarray,
@@ -66,6 +69,132 @@ def write_vtu(path: str | Path, vert: np.ndarray, tet: np.ndarray,
     a('</VTKFile>')
     path.write_text("\n".join(lines) + "\n")
     return path
+
+
+def read_vtu(path: str | Path):
+    """Read an ascii .vtu into (vert [n,3] f64, cells dict, point_data,
+    cell_data) — the ``PMMG_loadVtuMesh_centralized`` role
+    (inoutcpp_pmmg.cpp:44: load a centralized VTK mesh + metric/fields).
+
+    ``cells`` maps VTK type -> [m, k] int64 connectivity (10 = tetra
+    [m,4], 5 = triangle [m,3], 3 = line [m,2]); mixed-cell files
+    partition by type.  Data arrays come back as float64; cell_data rows
+    follow the FILE cell order, so per-type slices align with the cells
+    dict (types are returned in first-appearance order with stable
+    within-type order, and a "__order__" entry gives each type's row
+    indices into the original cell sequence).
+
+    Only ascii format is supported (the writer's own format and the
+    common interchange case); binary/appended raise ValueError rather
+    than mis-parse.
+    """
+    import xml.etree.ElementTree as ET
+    root = ET.parse(str(path)).getroot()
+    piece = root.find(".//Piece")
+    if piece is None:
+        raise ValueError(f"{path}: no <Piece> in VTU")
+
+    def arr_of(da):
+        if da.get("format", "ascii") != "ascii":
+            raise ValueError(
+                f"{path}: only ascii VTU supported "
+                f"(format={da.get('format')!r})")
+        text = da.text or ""
+        dt = np.float64 if da.get("type", "").startswith("Float") \
+            else np.int64
+        if not text.strip():
+            return np.zeros(0, dt)
+        return np.array(text.split(), dtype=dt)
+
+    pts = piece.find("Points/DataArray")
+    vert = arr_of(pts).astype(np.float64).reshape(-1, 3)
+
+    conn = offs = types = None
+    for da in piece.findall("Cells/DataArray"):
+        nm = da.get("Name")
+        if nm == "connectivity":
+            conn = arr_of(da).astype(np.int64)
+        elif nm == "offsets":
+            offs = arr_of(da).astype(np.int64)
+        elif nm == "types":
+            types = arr_of(da).astype(np.int64)
+    if conn is None or offs is None or types is None:
+        raise ValueError(f"{path}: incomplete <Cells> block")
+    starts = np.concatenate([[0], offs[:-1]])
+    cells: dict[int, np.ndarray] = {}
+    order: dict[int, np.ndarray] = {}
+    for t, k in ((_VTK_TETRA, 4), (_VTK_TRIANGLE, 3), (_VTK_LINE, 2)):
+        rows = np.where(types == t)[0]
+        if len(rows):
+            if not (offs[rows] - starts[rows] == k).all():
+                raise ValueError(f"{path}: cell type {t} with wrong "
+                                 "vertex count")
+            cells[t] = conn[starts[rows][:, None]
+                            + np.arange(k)[None, :]]
+            order[t] = rows
+    unknown = set(np.unique(types)) - {_VTK_TETRA, _VTK_TRIANGLE,
+                                       _VTK_LINE}
+    if unknown:
+        raise ValueError(f"{path}: unsupported VTK cell types "
+                         f"{sorted(unknown)}")
+
+    def data_of(tag, n):
+        out = {}
+        blk = piece.find(tag)
+        if blk is not None:
+            for da in blk.findall("DataArray"):
+                v = arr_of(da).astype(np.float64)
+                nc = int(da.get("NumberOfComponents", "1"))
+                out[da.get("Name", "field")] = \
+                    v.reshape(n, nc) if nc > 1 else v
+        return out
+
+    point_data = data_of("PointData", len(vert))
+    cell_data = data_of("CellData", len(types))
+    cell_data["__order__"] = order
+    return vert, cells, point_data, cell_data
+
+
+def read_vtu_medit(path: str | Path):
+    """.vtu -> MeditMesh (+ metric/fields), the ingest shape the CLI and
+    API load path consume.  References come from an integer-valued cell
+    field named like the Medit convention when present
+    ("medit:ref"/"ref"/"MaterialID"); otherwise zero."""
+    from .medit import MeditMesh
+    vert, cells, pdata, cdata = read_vtu(path)
+    order = cdata.pop("__order__", {})
+    m = MeditMesh()
+    m.vert = vert
+    m.vref = np.zeros(len(vert), np.int32)
+
+    def refs_for(t, n):
+        for nm in ("medit:ref", "ref", "MaterialID", "CellEntityIds"):
+            if nm in cdata and len(order.get(t, ())) and \
+                    len(cdata[nm]) >= len(order[t]):
+                v = np.asarray(cdata[nm])[order[t]]
+                if v.ndim == 1:
+                    return v.astype(np.int32)
+        return np.zeros(n, np.int32)
+
+    if _VTK_TETRA in cells:
+        m.tetra = cells[_VTK_TETRA].astype(np.int32)
+        m.tref = refs_for(_VTK_TETRA, len(m.tetra))
+    if _VTK_TRIANGLE in cells:
+        m.tria = cells[_VTK_TRIANGLE].astype(np.int32)
+        m.triaref = refs_for(_VTK_TRIANGLE, len(m.tria))
+    if _VTK_LINE in cells:
+        m.edges = cells[_VTK_LINE].astype(np.int32)
+        m.edgeref = refs_for(_VTK_LINE, len(m.edges))
+    # metric conventions: a scalar "metric"/"sol" point field, or the
+    # 6-component packed tensor
+    met = None
+    for nm in ("metric", "sol", "met"):
+        if nm in pdata:
+            met = pdata[nm]
+            break
+    fields = {k: v for k, v in pdata.items()
+              if k not in ("metric", "sol", "met")}
+    return m, met, fields
 
 
 def write_pvtu(path: str | Path, piece_files: list[str | Path],

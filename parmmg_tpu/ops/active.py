@@ -66,13 +66,24 @@ from .adjacency import build_adjacency
 
 NARROW_DIV = 4          # A = max(NARROW_MIN, capT // NARROW_DIV)
 NARROW_MIN = 8192
+
+
+def _narrow_div() -> int:
+    """Narrow-row divisor, env-overridable (PARMMG_NARROW_DIV): a larger
+    divisor shrinks every narrow-cycle pass proportionally, at the cost
+    of more frequent active-set overflows (which fall back to full-width
+    cycles, correct but slow) — tune against the workload's steady-state
+    footprint."""
+    import os
+    v = os.environ.get("PARMMG_NARROW_DIV", "")
+    return max(2, int(v)) if v else NARROW_DIV
 # fraction of A reserved for rows ALLOCATED by splits/swaps inside the
 # narrow cycle; the active set itself may only fill A - A//4
 NARROW_HEADROOM_DIV = 4
 
 
 def narrow_rows(capT: int) -> int:
-    return min(capT, max(NARROW_MIN, capT // NARROW_DIV))
+    return min(capT, max(NARROW_MIN, capT // _narrow_div()))
 
 
 def dirty_from_diff(pre: Mesh, post: Mesh, pre_met=None, post_met=None):
@@ -122,16 +133,22 @@ def extract_active(mesh: Mesh, active: jax.Array, A: int):
 
     Returns (sub, back, n_act, ovf): ``back[r]`` is the full-mesh slot a
     sub-mesh row writes back to — active rows keep their slot, rows past
-    ``n_act`` map to consecutive fresh slots at the full allocation
-    cursor (so in-sub allocations land in the full free region).
+    ``n_act`` map to the full mesh's FREE rows in pool order (so in-sub
+    allocations land in genuinely dead full slots, matching the
+    slot-reusing allocators — edges.free_rows).  Tail rows past the full
+    free count map to capT (write-back drops them; a LIVE such row is
+    the alloc-overflow signal checked in auto_cycle).
     ``ovf`` = the active set does not fit the budgeted rows (caller must
     abort the narrow cycle WITHOUT applying anything)."""
+    from .edges import free_rows
     capT = mesh.capT
     n_act = jnp.sum(active, dtype=jnp.int32)
     ovf = n_act > (A - A // NARROW_HEADROOM_DIV)
     ids = jnp.nonzero(active, size=A, fill_value=capT)[0].astype(jnp.int32)
+    ffree, _nfree = free_rows(mesh.tmask, A)
     r = jnp.arange(A, dtype=jnp.int32)
-    back = jnp.where(r < n_act, ids, mesh.nelem + (r - n_act))
+    back = jnp.where(r < n_act, ids,
+                     ffree[jnp.clip(r - n_act, 0, A - 1)])
     src = jnp.clip(ids, 0, capT - 1)
     pad = r >= n_act
     sub = dataclasses.replace(
@@ -154,12 +171,17 @@ def writeback_active(mesh: Mesh, sub: Mesh, back: jax.Array,
     capT drop (they are dead pad rows past the free region)."""
     capT = mesh.capT
     tgt = jnp.where(back < capT, back, capT)
+    tmask2 = mesh.tmask.at[tgt].set(sub.tmask, mode="drop",
+                                    unique_indices=True)
+    # exact watermark from the final liveness (free-pool targets may lie
+    # below the old watermark, and pad writes may tighten nothing)
+    rowsT = jnp.arange(capT, dtype=jnp.int32)
+    nelem2 = jnp.max(jnp.where(tmask2, rowsT + 1, 0))
     out = dataclasses.replace(
         mesh,
         tet=mesh.tet.at[tgt].set(sub.tet, mode="drop",
                                  unique_indices=True),
-        tmask=mesh.tmask.at[tgt].set(sub.tmask, mode="drop",
-                                     unique_indices=True),
+        tmask=tmask2,
         tref=mesh.tref.at[tgt].set(sub.tref, mode="drop",
                                    unique_indices=True),
         ftag=mesh.ftag.at[tgt].set(sub.ftag, mode="drop",
@@ -170,7 +192,7 @@ def writeback_active(mesh: Mesh, sub: Mesh, back: jax.Array,
                                    unique_indices=True),
         vert=sub.vert, vmask=sub.vmask, vtag=sub.vtag, vref=sub.vref,
         npoin=sub.npoin,
-        nelem=mesh.nelem + (sub.nelem - n_act))
+        nelem=nelem2)
     return out
 
 
@@ -235,12 +257,13 @@ def auto_cycle(mesh: Mesh, met, pending, okflag, wave, A: int,
             sub0, met, wave, do_swap=do_swap, do_smooth=do_smooth,
             do_insert=do_insert, final_rebuild=False, hausd=hausd,
             budget_div=narrow_budget_div, vact=d2, submesh=True)
-        # the sub's allocated rows land in the full free region; if the
-        # cycle allocated MORE rows than the full mesh has free, the
-        # writeback would silently drop tets (half-applied ops) — detect
-        # post-hoc and discard the whole cycle instead (exact; never
-        # trips at steady state where allocations are small)
-        alloc_bad = (sub.nelem - n_act2) > (mesh.capT - mesh.nelem)
+        # the sub's allocated rows land in full-mesh FREE rows via the
+        # back pool; a live sub row whose back target is the capT
+        # sentinel means the pool ran out and the writeback would
+        # silently drop a tet (half-applied ops) — detect post-hoc and
+        # discard the whole cycle instead (exact; never trips at steady
+        # state where allocations are small)
+        alloc_bad = jnp.any(sub.tmask & (back >= mesh.capT))
 
         def _apply(_):
             dn = dirty_from_diff(sub0, sub)

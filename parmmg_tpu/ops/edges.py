@@ -32,6 +32,27 @@ def wave_budget(capT: int, div: int = 8) -> int:
     return max(2048, capT // div)
 
 
+def free_rows(mask: jax.Array, K: int):
+    """First ``K`` dead rows (``mask`` False) — the slot-reusing
+    allocation pool shared by the allocating wave kernels (split,
+    swap23, swapgen).
+
+    Allocating from the watermark cursor alone (the rounds-1..3 scheme)
+    never reclaims interior rows freed by collapses; once the watermark
+    reaches capacity every split is capacity-dropped FOREVER even when
+    most of the array is dead — observed as a permanently-overflowing
+    bench at ~92% live fill (the reference instead reuses freed slots
+    through its linked free lists, MMG3D_newElt/MMG3D_delElt).  One
+    [cap]-width compaction per allocating wave buys exact slot reuse;
+    watermarks remain monotone upper bounds (used-prefix hints only —
+    mesh.py documents masks as authoritative).
+
+    Returns (rows [K] int32, cap-padded; nfree scalar int32)."""
+    cap = mask.shape[0]
+    rows = jnp.nonzero(~mask, size=K, fill_value=cap)[0].astype(jnp.int32)
+    return rows, jnp.sum(~mask, dtype=jnp.int32)
+
+
 def sort_pairs(a: jax.Array, b: jax.Array, valid: jax.Array, capP: int):
     """Sort (a, b) id pairs ascending, invalid slots last.
 

@@ -275,15 +275,18 @@ def split_wave(mesh: Mesh, met: jax.Array, lmax: float = LLONG,
             jnp.where(rowbad, e0, capE)].max(rowbad, mode="drop")[:capE]
 
         # --- final winner set + offsets, all at [KW] width -------------------
+        # allocation pools: reuse rows freed by earlier collapses (not a
+        # watermark cursor — see edges.free_rows)
+        from .edges import free_rows
         okv = wv & ~veto_e[wcc]
         win_i = okv.astype(jnp.int32)
         new_off = jnp.cumsum(win_i) - win_i
-        free_p = capP - mesh.npoin
-        fits_p = new_off < free_p
+        frow_p, nfree_p = free_rows(mesh.vmask, KW)
+        fits_p = new_off < jnp.minimum(nfree_p, KW)
         sh = jnp.where(okv & fits_p, et.nshell[wcc], 0)
         toff = jnp.cumsum(sh) - sh
-        free_t = capT - mesh.nelem
-        fits_cap = fits_p & ((toff + sh) <= free_t)
+        frow_t, nfree_t = free_rows(mesh.tmask, KH)
+        fits_cap = fits_p & ((toff + sh) <= jnp.minimum(nfree_t, KH))
         ok = okv & fits_cap
         # overflow = CAPACITY-dropped winners only (triggers a host
         # regrow); budget- or veto-dropped winners just defer
@@ -296,7 +299,7 @@ def split_wave(mesh: Mesh, met: jax.Array, lmax: float = LLONG,
         mid = 0.5 * (pa + pb)
         if lift_corr is not None:
             mid = mid + lift_corr[wcc]            # onto the Bezier surface
-        mid_id_w = (mesh.npoin + new_off).astype(jnp.int32)
+        mid_id_w = frow_p[jnp.clip(new_off, 0, KW - 1)]
         tgt_w = jnp.where(ok, mid_id_w, capP)
         vert = mesh.vert.at[tgt_w].set(mid, mode="drop", unique_indices=True)
         vmask = mesh.vmask.at[tgt_w].set(True, mode="drop",
@@ -311,12 +314,13 @@ def split_wave(mesh: Mesh, met: jax.Array, lmax: float = LLONG,
         met_new = met.at[tgt_w].set(_interp_met_mid(met, va_w, vb_w),
                                     mode="drop", unique_indices=True)
 
-        # --- allocation tables: midpoint vid + tet-slot base per edge --------
-        # ONE packed [KW] scatter; -1 marks non-winning edges
+        # --- allocation tables: midpoint vid + free-pool base per edge -------
+        # ONE packed [KW] scatter; -1 marks non-winning edges.  Column 1
+        # is the edge's base OFFSET into the frow_t free pool (its shell
+        # tets take consecutive pool entries, not consecutive slots)
         alloc = jnp.full((capE, 2), -1, jnp.int32).at[
             jnp.where(ok, wc, capE)].set(
-            jnp.stack([mid_id_w,
-                       (mesh.nelem + toff).astype(jnp.int32)], axis=1),
+            jnp.stack([mid_id_w, toff.astype(jnp.int32)], axis=1),
             mode="drop", unique_indices=True)
 
         # --- split shell tets on the same [KH] compaction --------------------
@@ -326,9 +330,11 @@ def split_wave(mesh: Mesh, met: jax.Array, lmax: float = LLONG,
         al_row = alloc[e0]                                # [KH,2]
         hv = hv0 & (al_row[:, 0] >= 0)
         mh = jnp.clip(al_row[:, 0], 0, capP - 1)
-        # rank of this tet within its shell -> new tet slot (the shell
-        # rank precomputed by unique_edges: sorted-segment rank)
-        new_tid_r = al_row[:, 1] + et.shell_rank[hc, loc0]
+        # rank of this tet within its shell -> new tet slot from the
+        # free pool (the shell rank precomputed by unique_edges:
+        # sorted-segment rank)
+        new_tid_r = frow_t[jnp.clip(al_row[:, 1] + et.shell_rank[hc, loc0],
+                                    0, KH - 1)]
         tgt1 = jnp.where(hv, hc, capT)
         tgt2 = jnp.where(hv, jnp.clip(new_tid_r, 0, capT - 1), capT)
         # tet1 (in place): vertex j -> m ; tet2 (new slot): vertex i -> m
@@ -360,8 +366,12 @@ def split_wave(mesh: Mesh, met: jax.Array, lmax: float = LLONG,
         etag_out = etag_out.at[tgt2].set(etag2r, mode="drop",
                                          unique_indices=True)
 
-        npoin = mesh.npoin + nwin
-        nelem = mesh.nelem + jnp.sum(hv, dtype=jnp.int32)
+        # watermarks stay monotone upper bounds over used rows (pool
+        # rows may lie below the old watermark — reuse tightens nothing)
+        npoin = jnp.maximum(mesh.npoin,
+                            jnp.max(jnp.where(ok, mid_id_w + 1, 0)))
+        nelem = jnp.maximum(
+            mesh.nelem, jnp.max(jnp.where(hv, new_tid_r + 1, 0)))
         out = dataclasses.replace(
             mesh, vert=vert, vmask=vmask, vtag=vtag, vref=vref,
             tet=tet_out, tmask=tmask, tref=tref,

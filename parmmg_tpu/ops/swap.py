@@ -577,13 +577,18 @@ def swap23_wave(mesh: Mesh, met: jax.Array,
     dup_sorted = win[order_d] & ~first_d
     win = win & ~jnp.zeros(F, bool).at[order_d].set(
         dup_sorted, unique_indices=True)
+    # slot-reusing allocation from the free pool (edges.free_rows):
+    # rows freed by collapses are reclaimed instead of bumping the
+    # watermark cursor
+    from .edges import free_rows
+    frow_t, nfree_t = free_rows(mesh.tmask, F)
     w_i = win.astype(jnp.int32)
     off = jnp.cumsum(w_i) - w_i
-    fits = off < (capT - mesh.nelem)
+    fits = off < jnp.minimum(nfree_t, F)
     win = win & fits
     w_i = win.astype(jnp.int32)
     off = jnp.cumsum(w_i) - w_i
-    t3 = (mesh.nelem + off).astype(jnp.int32)
+    t3 = frow_t[jnp.clip(off, 0, F - 1)]
 
     # --- tag routing: the fan tet over ring edge (x,y) inherits the two
     # exterior faces (x,y,a) [old T1, opposite the third ring vertex] and
@@ -636,7 +641,8 @@ def swap23_wave(mesh: Mesh, met: jax.Array,
     etag = mesh.etag.at[idx3].set(jnp.concatenate(etag_n), mode="drop")
     fref = mesh.fref.at[idx3].set(jnp.concatenate(fref_n), mode="drop")
     nsw = jnp.sum(w_i)
-    nelem = mesh.nelem + nsw
+    nelem = jnp.maximum(mesh.nelem,
+                        jnp.max(jnp.where(win, t3 + 1, 0)))
     out = dataclasses.replace(mesh, tet=tet, tmask=tmask, tref=tref,
                               ftag=ftag, etag=etag, fref=fref,
                               nelem=nelem.astype(jnp.int32))

@@ -273,13 +273,17 @@ def swapgen_wave(mesh: Mesh, met: jax.Array,
     win = claim_shells(q_new - q_old, cand, sh_eff, capT)
 
     # ---- allocation of the extra (n-4) slots -----------------------------
+    # slot-reusing pool (edges.free_rows): each winner takes up to
+    # RING_MAX-4 consecutive POOL entries, not consecutive slots
+    from .edges import free_rows
+    LF = 2 * K
+    frow_t, nfree_t = free_rows(mesh.tmask, LF)
     extra = jnp.where(win, n - 4, 0)
     off = jnp.cumsum(extra) - extra
-    fits = (off + extra) <= (capT - mesh.nelem)
+    fits = (off + extra) <= jnp.minimum(nfree_t, LF)
     win = win & fits
     extra = jnp.where(win, n - 4, 0)
     off = jnp.cumsum(extra) - extra
-    base_new = (mesh.nelem + off).astype(jnp.int32)
 
     # ---- gather the winning fan's rows + route tags ----------------------
     tets_best = jnp.stack(fan_tets, 1)[ar, best_c]       # [K, NT_NEW, 4]
@@ -365,8 +369,9 @@ def swapgen_wave(mesh: Mesh, met: jax.Array,
         idx_all = []
         for m in range(NT_NEW):
             valid_m = win & (m < 2 * (n - 2))
-            tgt = jnp.where(m < n, shc[:, min(m, RING_MAX - 1)],
-                            base_new + jnp.maximum(m - n, 0))
+            tgt = jnp.where(
+                m < n, shc[:, min(m, RING_MAX - 1)],
+                frow_t[jnp.clip(off + jnp.maximum(m - n, 0), 0, LF - 1)])
             idx_all.append(jnp.where(valid_m, tgt, capT))
         idx_cat = jnp.concatenate(idx_all)
         tet_o = tet_o.at[idx_cat].set(
@@ -392,7 +397,9 @@ def swapgen_wave(mesh: Mesh, met: jax.Array,
 
     tet_o, ftag_o, fref_o, etag_o, tmask_o, tref_o = jax.lax.cond(
         nsw > 0, _apply, _skip, None)
-    nelem = mesh.nelem + jnp.sum(extra)
+    used_hi = jnp.where(extra > 0,
+                        frow_t[jnp.clip(off + extra - 1, 0, LF - 1)] + 1, 0)
+    nelem = jnp.maximum(mesh.nelem, jnp.max(used_hi))
     out = dataclasses.replace(
         mesh, tet=tet_o, tmask=tmask_o, tref=tref_o, ftag=ftag_o,
         fref=fref_o, etag=etag_o, nelem=nelem.astype(jnp.int32))

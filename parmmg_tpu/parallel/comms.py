@@ -339,6 +339,56 @@ def halo_exchange(vals, send_idx, nbr, axis_name: str = "shard",
     return jnp.where(valid, recv, 0)
 
 
+def halo_exchange_grouped(vals, send_idx, nbr, G: int,
+                          axis_name: str = "shard"):
+    """Grouped halo exchange: G logical shards per device (the
+    groups x shards composition, grpsplit_pmmg.c:1551 role).
+
+    Logical shard ``l`` lives on device ``l // G`` at slot ``l % G``;
+    ``nbr`` carries LOGICAL shard ids.  Routing is (dest_device,
+    dest_slot): each device scatters its per-(group, neighbor) rows into
+    a [S, G, G, I] send block — mat[dd, g, ds] = my group g's items for
+    slot ds of device dd — and ONE ``all_to_all`` transposes the device
+    axis, after which recv_mat[sd, sg, g] is what logical shard
+    sd*G+sg sent my group g.  Same-device neighbor pairs ride the
+    self-row of the tiled collective.  Traffic carries a G^2 slot
+    factor; the exchange runs on interface-sized I between outer
+    iterations, where simplicity beats compaction.
+
+    vals [G, P, ...]; send_idx [G, K, I]; nbr [G, K] logical ids.
+    Returns recv [G, K, I, ...] (zeros on pads)."""
+    import jax
+    import jax.numpy as jnp
+
+    Gk, K, I = send_idx.shape
+    assert Gk == G
+    S = jax.lax.axis_size(axis_name)
+    P_ = vals.shape[1]
+    safe = jnp.clip(send_idx, 0, P_ - 1)                 # [G,K,I]
+    g_ar = jnp.arange(G)[:, None, None]
+    gath = vals[jnp.broadcast_to(g_ar, send_idx.shape), safe]
+    vmask = (send_idx >= 0)
+    if gath.ndim > 3:
+        vmask = vmask.reshape(G, K, I, *([1] * (gath.ndim - 3)))
+    send = jnp.where(vmask, gath, 0)                     # [G,K,I,...]
+    tail = send.shape[3:]
+    dd = jnp.where(nbr >= 0, nbr // G, S)                # [G,K]
+    ds = jnp.where(nbr >= 0, nbr % G, 0)
+    mat = jnp.zeros((S, G, G, I) + tail, send.dtype)
+    mat = mat.at[dd, jnp.broadcast_to(jnp.arange(G)[:, None], (G, K)),
+                 ds].set(send, mode="drop", unique_indices=True)
+    recv_mat = jax.lax.all_to_all(mat, axis_name, split_axis=0,
+                                  concat_axis=0, tiled=True)
+    # recv_mat[sd, sg, my_g, I, ...]
+    sd = jnp.clip(nbr // G, 0, S - 1)
+    sg = jnp.clip(nbr % G, 0, G - 1)
+    recv = recv_mat[sd, sg,
+                    jnp.broadcast_to(jnp.arange(G)[:, None], (G, K))]
+    valid = (nbr >= 0)
+    valid = valid.reshape(G, K, *([1] * (recv.ndim - 2)))
+    return jnp.where(valid, recv, 0)
+
+
 def merge_owner_max(vals, send_idx, recv):
     """Merge received neighbor values into local entity values with the
     max rule (the reference's max-rank/max-value priority merges)."""

@@ -114,12 +114,21 @@ def dist_adapt_cycle(dmesh: DeviceMesh, do_swap: bool = True,
 
 def dist_adapt_block(dmesh: DeviceMesh, swap_flags: tuple,
                      do_smooth: bool = True, do_insert: bool = True,
-                     hausd: float | None = None):
+                     hausd: float | None = None, G: int = 1):
     """SPMD fused cycle block: ``len(swap_flags)`` adapt cycles in ONE
     jitted shard_map program — the production analogue of
     ops.adapt.adapt_cycles_fused.  One dispatch + one psum'd counter
     pull per block instead of per cycle: on the tunneled chip each
     dispatch pays a ~70-110 ms transport round trip.
+
+    ``G`` > 1 is the groups x shards composition (the reference's
+    rank-level x group-level two-level loop, grpsplit_pmmg.c:1551-1614,
+    libparmmg1.c:597-636): the stacked leading axis holds S*G LOGICAL
+    shards, G consecutive rows per device; inside the shard_map body a
+    ``lax.map`` serializes the device's G groups through ONE compiled
+    group-shaped cycle program, so peak HBM per chip is the G resident
+    group states plus a single group's wave working set — the bound
+    that makes meshes far beyond one group's HBM feasible per chip.
 
     Returns fn(stacked_mesh, stacked_met, wave0) ->
       (stacked_mesh, stacked_met, global_counts[n,4], any_overflow).
@@ -127,9 +136,7 @@ def dist_adapt_block(dmesh: DeviceMesh, swap_flags: tuple,
     from ..ops.adapt import adapt_cycle_impl
     spec = P("shard")
 
-    def local_block(mesh_s: Mesh, met_s, wave0):
-        mesh = _unstack(mesh_s)
-        met = met_s[0]
+    def one_shard(mesh: Mesh, met, wave0):
         counts_all = []
         for c, dosw in enumerate(swap_flags):
             mesh, met, counts = adapt_cycle_impl(
@@ -137,10 +144,22 @@ def dist_adapt_block(dmesh: DeviceMesh, swap_flags: tuple,
                 do_insert=do_insert, smooth_waves=2, hausd=hausd,
                 final_rebuild=(c == len(swap_flags) - 1))
             counts_all.append(counts)
-        cs = jnp.stack(counts_all)                         # [n, 6]
+        return mesh, met, jnp.stack(counts_all)            # [n, 8]
+
+    def local_block(mesh_s: Mesh, met_s, wave0):
+        if G == 1:
+            mesh, met, cs = one_shard(_unstack(mesh_s), met_s[0], wave0)
+            mesh_s, met_s = _restack(mesh), met[None]
+        else:
+            def body(args):
+                m, k = args
+                return one_shard(m, k, wave0)
+            mesh_s, met_s, cs_g = jax.lax.map(body, (mesh_s, met_s))
+            cs = jnp.sum(cs_g, axis=0)                     # [n, 8]
+            cs = cs.at[:, 4].set(jnp.max(cs_g[:, :, 4], axis=0))
         ovf = jax.lax.pmax(jnp.max(cs[:, 4]), "shard")
         counts = jax.lax.psum(cs[:, :4], "shard")
-        return _restack(mesh), met[None], counts, ovf
+        return mesh_s, met_s, counts, ovf
 
     fn = shard_map(local_block, mesh=dmesh,
                    in_specs=(spec, spec, P()),
@@ -157,10 +176,11 @@ class DistSteps:
     these and reuse it."""
 
     def __init__(self, dmesh: DeviceMesh, do_smooth: bool = True,
-                 do_insert: bool = True, hausd: float | None = None):
+                 do_insert: bool = True, hausd: float | None = None,
+                 G: int = 1):
         self.dmesh = dmesh
         self.kw = dict(do_smooth=do_smooth, do_insert=do_insert,
-                       hausd=hausd)
+                       hausd=hausd, G=G)
         self._cache: dict = {}
 
     def get(self, flags: tuple):
@@ -171,7 +191,7 @@ class DistSteps:
         return self._cache[flags]
 
 
-def dist_interface_check(dmesh: DeviceMesh):
+def dist_interface_check(dmesh: DeviceMesh, G: int = 1):
     """On-device interface echo (PMMG_check_extNodeComm on the jittable
     exchange): every shard sends its interface vertices' coordinates +
     metric through :func:`halo_exchange` and compares against the mirror
@@ -179,23 +199,31 @@ def dist_interface_check(dmesh: DeviceMesh):
     the ordering contract of the comm tables — runs once per outer
     iteration in distributed_adapt.
 
+    ``G`` > 1: groups x shards composition — the stacked leading axis is
+    S*G logical shards and the exchange routes (dest_device, dest_slot)
+    through :func:`comms.halo_exchange_grouped`.
+
     Returns fn(stacked_mesh, stacked_met, node_idx[S,K,I], nbr[S,K],
     tol) -> global mismatch count.
     """
-    from .comms import halo_exchange
+    from .comms import halo_exchange, halo_exchange_grouped
     spec = P("shard")
 
     def local(mesh_s: Mesh, met_s, node_idx_s, nbr_s, tol):
-        mesh = _unstack(mesh_s)
-        met = met_s[0]
-        node_idx = node_idx_s[0]
-        nbr = nbr_s[0]
-        m2 = met[:, None] if met.ndim == 1 else met
-        vals = jnp.concatenate([mesh.vert, m2.astype(mesh.vert.dtype)],
-                               axis=1)                     # [capP, 3+m]
-        recv = halo_exchange(vals, node_idx, nbr)          # [K, I, 3+m]
-        mine = vals[jnp.clip(node_idx, 0, mesh.capP - 1)]
-        valid = (node_idx >= 0)[..., None]
+        met_g = met_s[..., None] if met_s.ndim == 2 else met_s
+        vals_g = jnp.concatenate(
+            [mesh_s.vert, met_g.astype(mesh_s.vert.dtype)],
+            axis=-1)                                     # [G, capP, 3+m]
+        if G == 1:
+            recv = halo_exchange(vals_g[0], node_idx_s[0],
+                                 nbr_s[0])[None]          # [1,K,I,3+m]
+        else:
+            recv = halo_exchange_grouped(vals_g, node_idx_s, nbr_s, G)
+        capP = mesh_s.vert.shape[1]
+        g_ar = jnp.arange(G)[:, None, None]
+        mine = vals_g[jnp.broadcast_to(g_ar, node_idx_s.shape),
+                      jnp.clip(node_idx_s, 0, capP - 1)]
+        valid = (node_idx_s >= 0)[..., None]
         bad = valid & (jnp.abs(recv - jnp.where(valid, mine, 0)) > tol)
         n_bad = jnp.sum(bad.astype(jnp.int32))
         return jax.lax.psum(n_bad, "shard")
@@ -355,11 +383,11 @@ def dist_quality(dmesh: DeviceMesh):
     return jax.jit(fn)
 
 
-def check_interface_echo(stacked, met_s, comms, dmesh, vert_h):
+def check_interface_echo(stacked, met_s, comms, dmesh, vert_h, G: int = 1):
     """On-device interface coordinate+metric echo (the production chkcomm
     guard, chkcomm_pmmg.c:815 role); raises on an ordering-contract
     violation."""
-    chk = dist_interface_check(dmesh)
+    chk = dist_interface_check(dmesh, G=G)
     diag = float(np.linalg.norm(vert_h.max(0) - vert_h.min(0))) \
         if len(vert_h) else 1.0
     nbad = int(chk(
@@ -545,8 +573,20 @@ def distributed_adapt_multi(mesh: Mesh, met, n_shards: int,
                             ifc_layers: int = 2,
                             nobalancing: bool = False,
                             part: np.ndarray | None = None,
-                            mode: str = "ifc"):
+                            mode: str = "ifc",
+                            n_devices: int | None = None):
     """Shard-resident multi-iteration adaptation (host driver).
+
+    ``n_devices``: groups x shards composition (default = ``n_shards``,
+    i.e. one logical shard per device).  With ``n_devices`` <
+    ``n_shards``, G = n_shards // n_devices logical shards live on each
+    device (leading-axis sharding, G consecutive rows per device); the
+    adapt block serializes them with ``lax.map`` so peak HBM per chip is
+    bounded by one group's wave working set — the reference's rank-level
+    x group-level two-level decomposition (grpsplit_pmmg.c:1551-1614).
+    The band-migration and flood programs already operate on the logical
+    leading axis (plain jit over sharded arrays) and compose unchanged;
+    the analysis refresh takes the host path for G > 1.
 
     ``mode``: between-iteration label source — "ifc" = advancing-front
     interface displacement (device flood, the default repartitioning of
@@ -583,14 +623,29 @@ def distributed_adapt_multi(mesh: Mesh, met, n_shards: int,
                           flood_labels, enforce_ne_min, migrate_shards,
                           rebuild_shards, weld_shard_bands,
                           graph_repartition_labels)
-    from .multihost import require_single_process
+    from .multihost import (require_single_process, pull_host as _pull,
+                            is_multiprocess)
 
-    # the host orchestration below (split, views pull, migration
-    # packaging, merge) is single-controller today — fail loudly on a
-    # multi-process runtime instead of computing from a partial view
-    require_single_process("distributed_adapt_multi host orchestration")
+    # Multi-process contract (round 4, the mpi_pmmg.h role): every
+    # process runs THIS SAME driver on the SAME input mesh (identical
+    # split + comm tables — the deterministic-host-stage SPMD idiom);
+    # device arrays are global ('shard'-sharded across processes via
+    # shard_stacked_global), band-table host pulls replicate through
+    # pull_host (DCN allgather of band-sized data), and every process
+    # computes identical host decisions — the reference's
+    # every-rank-agrees design (MPI_Allreduce on ier/counters).  The
+    # full-view fallback paths are NOT distributed: they raise below
+    # rather than silently pulling a partial world view.
+    multi = is_multiprocess()
+    if n_devices is None:
+        n_devices = n_shards
+    if n_shards % n_devices:
+        raise ValueError(
+            f"n_shards={n_shards} must be a multiple of "
+            f"n_devices={n_devices} (G logical shards per device)")
+    G = n_shards // n_devices
     if dmesh is None:
-        dmesh = make_device_mesh(n_shards)
+        dmesh = make_device_mesh(n_devices)
     ang = ANGEDG if angedg is None else angedg
 
     vert_h, tet_h, vref_h, tref_h, vtag_h = mesh_to_host(mesh)
@@ -625,10 +680,10 @@ def distributed_adapt_multi(mesh: Mesh, met, n_shards: int,
         glo[s_][: len(l2g[s_])] = l2g[s_]
     top = len(vert_h)
 
-    check_interface_echo(stacked, met_s, comms, dmesh, vert_h)
+    check_interface_echo(stacked, met_s, comms, dmesh, vert_h, G=G)
 
     steps = DistSteps(dmesh, do_smooth=not nomove,
-                      do_insert=not noinsert, hausd=hausd)
+                      do_insert=not noinsert, hausd=hausd, G=G)
 
     def grow_glo(old_capP):
         # keep the global-numbering tables in lockstep with a device
@@ -647,6 +702,10 @@ def distributed_adapt_multi(mesh: Mesh, met, n_shards: int,
     # round 4: cluster graph from device-compacted tables,
     # migrate_dev.graph_repartition_labels_band)
     use_band = _os.environ.get("PARMMG_BAND_PATH", "1") != "0"
+    if multi and not use_band:
+        raise NotImplementedError(
+            "multi-process runs require the band path (the full-view "
+            "loop is single-controller)")
     glo_d = None
     shared_prev = None
     if use_band:
@@ -688,15 +747,15 @@ def distributed_adapt_multi(mesh: Mesh, met, n_shards: int,
             if ids_fit and bool(oke):
                 glo_d = glo_d2
                 top = int(top_d)
-                f_rows = np.asarray(f_rows)
-                f_gids = np.asarray(f_gids)
-                vmask_h = np.asarray(stacked.vmask)
+                f_rows = _pull(f_rows)
+                f_gids = _pull(f_gids)
+                vmask_h = _pull(stacked.vmask)
                 for s_ in range(n_shards):
                     m = f_rows[s_] >= 0
                     glo[s_][f_rows[s_][m]] = f_gids[s_][m]
                     glo[s_][~vmask_h[s_]] = -1
             else:               # fresh-id budget blown: host extend
-                vmask_h = np.asarray(stacked.vmask)
+                vmask_h = _pull(stacked.vmask)
                 top = extend_global_ids_from_vmask(glo, vmask_h, top)
                 if top >= 2 ** 31:
                     # the int32 device numbering can no longer represent
@@ -708,15 +767,23 @@ def distributed_adapt_multi(mesh: Mesh, met, n_shards: int,
                 else:
                     glo_d = jnp.asarray(np.stack(glo).astype(np.int32))
         else:
-            vmask_h = np.asarray(stacked.vmask)
+            vmask_h = _pull(stacked.vmask)
             top = extend_global_ids_from_vmask(glo, vmask_h, top)
-        st2 = refresh_shard_analysis_device(
+        # device analysis refresh is per-device shard_map (G=1 layout);
+        # grouped runs take the host path (correct, host-width) until
+        # the grouped analysis program lands
+        st2 = None if G > 1 else refresh_shard_analysis_device(
             stacked, comms, n_shards, ang, glo, dmesh, cache=ana_cache)
         views = None
         if st2 is not None:
             stacked = st2
         else:
             # host fallback (shared-record budget overflow)
+            if multi:
+                raise NotImplementedError(
+                    "analysis host fallback needs a full-view pull — "
+                    "not distributed; raise the KS budget or run "
+                    "single-process")
             views = pull_views(stacked, met_s)
             stacked = refresh_shard_analysis(
                 stacked, comms, n_shards, ang, glo=glo, views=views)
@@ -802,11 +869,16 @@ def distributed_adapt_multi(mesh: Mesh, met, n_shards: int,
                                 np.stack(glo).astype(np.int32))
                         stacked = rebuild_shards(stacked)
                         check_interface_echo(stacked, met_s, comms,
-                                             dmesh, vert_h)
+                                             dmesh, vert_h, G=G)
                 elif verbose >= 1:
                     print(f"  it {it}: band budgets exceeded — "
                           "falling back to the full-view path")
             if not band_done:
+                if multi:
+                    raise NotImplementedError(
+                        "full-view migration fallback is "
+                        "single-controller; band budgets must hold on "
+                        "a multi-process run")
                 if views is None:
                     views = pull_views(stacked, met_s)
                 if mode == "graph":
@@ -843,13 +915,21 @@ def distributed_adapt_multi(mesh: Mesh, met, n_shards: int,
                         touched=touched, verbose=verbose)
                     stacked = rebuild_shards(stacked)
                     check_interface_echo(stacked, met_s, comms, dmesh,
-                                         vert_h)
+                                         vert_h, G=G)
                 if use_band:    # resync the device numbering copy
                     glo_d = jnp.asarray(np.stack(glo).astype(np.int32))
                     shared_prev = _shared_gids(comms, glo, n_shards)
             if nmoved and verbose >= 2:
                 print(f"  it {it}: migrated {nmoved} interface-band "
                       "tets")
+    if multi:
+        # final output: replicate the (end-state) shards to every
+        # process and merge identically everywhere — the
+        # centralized-output analogue of PMMG_parmmglib_centralized's
+        # gather (the distributed-output entry, io.distributed, writes
+        # per-process rank files instead and never pays this gather)
+        stacked = jax.tree.map(_pull, stacked)
+        met_s = _pull(met_s)
     merged, met_m, part_new = merge_shards(stacked, met_s,
                                            return_part=True)
     return merged, met_m, part_new

@@ -40,6 +40,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .multihost import pull_host as _pull
 from ..core.mesh import Mesh
 from ..core.constants import IDIR
 
@@ -562,7 +563,7 @@ def band_migrate_iteration(stacked: Mesh, met_s, glo_d,
         if verbose >= 1:
             names = ("nmove<=KB", "arrivals<=KB", "new_v<=KV",
                      "new_v<=free_v", "arrivals<=free_t")
-            parts = np.asarray(info["ok_parts"])
+            parts = _pull(info["ok_parts"])
             bad = [n for n, p in zip(names, parts) if not p]
             print(f"  band migrate overflow: {bad}")
         return None         # fallback: caller re-runs the full path
@@ -576,9 +577,9 @@ def band_migrate_iteration(stacked: Mesh, met_s, glo_d,
         return None
 
     # ---- cross-shard face match -----------------------------------------
-    keys = np.asarray(keys)
-    slots = np.asarray(slots)
-    cnt = np.asarray(cnt)
+    keys = _pull(keys)
+    slots = _pull(slots)
+    cnt = _pull(cnt)
     ks, sl, sh = [], [], []
     for s in range(S):
         n = int(cnt[s])
@@ -602,9 +603,9 @@ def band_migrate_iteration(stacked: Mesh, met_s, glo_d,
     # ---- host glo mirror sync (arrivals + liveness) ---------------------
     # (after the pairing guard: a None return above must leave the host
     # glo mirror untouched for the full-view fallback)
-    arr_rows = np.asarray(info["arr_rows"])
-    arr_gids = np.asarray(info["arr_gids"])
-    vmask_h = np.asarray(stacked2.vmask)
+    arr_rows = _pull(info["arr_rows"])
+    arr_gids = _pull(info["arr_gids"])
+    vmask_h = _pull(stacked2.vmask)
     for s in range(S):
         m = arr_rows[s] >= 0
         glo[s][arr_rows[s][m]] = arr_gids[s][m].astype(np.int64)
@@ -692,7 +693,7 @@ def band_migrate_iteration(stacked: Mesh, met_s, glo_d,
               f"{len(iA)} interface faces, {int(shared.sum())} shared "
               "vertices (device path)")
     return (stacked2, met2, glo_d2, comms, shared_now, nmoved,
-            np.asarray(info["arr_slots"]))
+            _pull(info["arr_slots"]))
 
 
 def band_weld(stacked: Mesh, met_s, glo_d, glo: list[np.ndarray],
@@ -715,22 +716,22 @@ def band_weld(stacked: Mesh, met_s, glo_d, glo: list[np.ndarray],
         stacked, glo_d, seed, KW=KW, KWp=KWp)
     if not bool(ok):
         return stacked, glo_d, -1   # caller may fall back
-    trow = np.asarray(trow)
-    vrow = np.asarray(vrow)
-    tcnt = np.asarray(tcnt)
-    vcnt = np.asarray(vcnt)
-    v_open = np.asarray(v_open)
+    trow = _pull(trow)
+    vrow = _pull(vrow)
+    tcnt = _pull(tcnt)
+    vcnt = _pull(vcnt)
+    v_open = _pull(v_open)
     # one consolidated gather pull of the region rows
     sidx = jnp.arange(S)[:, None]
     tr_c = jnp.clip(jnp.asarray(trow), 0, capT - 1)
     vr_c = jnp.clip(jnp.asarray(vrow), 0, capP - 1)
-    tet_r = np.asarray(stacked.tet[sidx, tr_c])
-    tref_r = np.asarray(stacked.tref[sidx, tr_c])
-    ftag_r = np.asarray(stacked.ftag[sidx, tr_c])
-    etag_r = np.asarray(stacked.etag[sidx, tr_c])
-    vert_r = np.asarray(stacked.vert[sidx, vr_c])
-    vtag_r = np.asarray(stacked.vtag[sidx, vr_c])
-    met_r = np.asarray(met_s[sidx, vr_c])
+    tet_r = _pull(stacked.tet[sidx, tr_c])
+    tref_r = _pull(stacked.tref[sidx, tr_c])
+    ftag_r = _pull(stacked.ftag[sidx, tr_c])
+    etag_r = _pull(stacked.etag[sidx, tr_c])
+    vert_r = _pull(stacked.vert[sidx, vr_c])
+    vtag_r = _pull(stacked.vtag[sidx, vr_c])
+    met_r = _pull(met_s[sidx, vr_c])
     tet_d = stacked.tet
     tmask_d = stacked.tmask
     vmask_d = stacked.vmask
@@ -902,7 +903,7 @@ def repair_flood_labels(stacked: Mesh, labels_d, depth_d, n_shards: int,
     Returns (labels_d, nfixed).  Reference semantics:
     moveinterfaces_pmmg.c:475-626 (fix_contiguity merge into a neighbor
     color) and :627-720 (check_reachability revert)."""
-    cnts = np.asarray(flood_band_counts(stacked, labels_d, n_shards))
+    cnts = _pull(flood_band_counts(stacked, labels_d, n_shards))
     if int(cnts.max()) == 0:
         return labels_d, 0
     capT = stacked.tet.shape[1]

@@ -115,3 +115,27 @@ def require_single_process(what: str) -> None:
             "use the per-process distributed I/O entry "
             "(io.distributed) — multi-process host orchestration is the "
             "next step documented in parallel/multihost.py")
+
+
+def pull_host(x) -> np.ndarray:
+    """Device -> host pull that is correct on a multi-process runtime.
+
+    Single-process (or an already fully-addressable / replicated array):
+    plain ``np.asarray``.  Multi-process with a 'shard'-sharded global
+    array: every process holds only its addressable slices, so the pull
+    is a ``process_allgather`` — each process receives the full value
+    and the host stages compute identically everywhere (the reference's
+    every-rank-agrees idiom: its host decisions ride MPI_Allreduce/
+    Allgather the same way, e.g. the distributegrps_pmmg.c:1631
+    metadata exchange).  Band-path tables are band/interface-sized, so
+    replicating them is DCN-cheap; the full-view fallback paths must NOT
+    be pulled this way (guarded by require_single_process at their
+    entry)."""
+    import jax
+    if isinstance(x, np.ndarray):
+        return x
+    if jax.process_count() == 1 or not isinstance(x, jax.Array) \
+            or x.is_fully_addressable:
+        return np.asarray(x)
+    from jax.experimental import multihost_utils
+    return np.asarray(multihost_utils.process_allgather(x, tiled=True))

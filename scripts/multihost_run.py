@@ -1,0 +1,159 @@
+"""Two-process distributed adaptation demo (multi-host step 1).
+
+Spawns NP jax.distributed processes on this host (virtual CPU devices,
+``xla_force_host_platform_device_count``), each running the IDENTICAL
+``distributed_adapt_multi`` driver on the same input — the SPMD host
+idiom of the reference's MPI program (every rank executes libparmmg1.c's
+loop; host decisions agree through collectives).  Device arrays are
+global ('shard'-sharded across the processes), band-table host pulls
+replicate through ``multihost.pull_host`` (DCN allgather), and the run
+exercises the full split -> adapt -> band-migrate -> weld -> merge
+pipeline with the single-process guards removed.
+
+Usage:  python scripts/multihost_run.py [--np 2] [--devices 4] [--n 4]
+Writes a per-process log to /tmp/parmmg_mh_<pid>.log and prints ONE
+JSON summary line from process 0 (recorded as MULTIHOST2P_r04.json by
+the round driver or by hand).
+
+Kept out of the default test matrix: on a 1-core CI image two processes
+compile the SPMD graph concurrently and starve each other (documented
+in ROUND_NOTES round 3); run it manually or from a beefier driver.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import socket
+import subprocess
+import sys
+import time
+
+
+def free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    p = s.getsockname()[1]
+    s.close()
+    return p
+
+
+def worker() -> None:
+    import numpy as np
+    import jax
+
+    pid = int(os.environ["JAX_PROCESS_ID"])
+    np_proc = int(os.environ["JAX_NUM_PROCESSES"])
+    n = int(os.environ["MH_N"])
+    ndev = int(os.environ["MH_DEVICES"])
+    log = open(f"/tmp/parmmg_mh_{pid}.log", "w")
+
+    def say(msg):
+        print(msg, file=log, flush=True)
+        if pid == 0:
+            print(msg, file=sys.stderr, flush=True)
+
+    t0 = time.time()
+    from parmmg_tpu.parallel.multihost import init_multihost
+    assert init_multihost(), "jax.distributed must initialize"
+    say(f"[p{pid}] initialized: {jax.process_count()} processes, "
+        f"{jax.device_count()} global / {jax.local_device_count()} "
+        f"local devices ({time.time() - t0:.1f}s)")
+    assert jax.process_count() == np_proc
+
+    import jax.numpy as jnp
+    from parmmg_tpu.core.mesh import make_mesh
+    from parmmg_tpu.ops.analysis import analyze_mesh
+    from parmmg_tpu.ops.quality import tet_quality
+    from parmmg_tpu.utils.fixtures import cube_mesh, analytic_iso_metric
+    from parmmg_tpu.parallel.dist import distributed_adapt_multi
+
+    # identical input on every process (the deterministic-host contract)
+    vert, tet = cube_mesh(n)
+    mesh = make_mesh(vert, tet, capP=4 * len(vert), capT=4 * len(tet))
+    mesh = analyze_mesh(mesh).mesh
+    h = analytic_iso_metric(vert, "shock", h=1.8 / n)
+    met = jnp.zeros(mesh.capP, mesh.vert.dtype).at[: len(h)].set(
+        jnp.asarray(h, mesh.vert.dtype)).at[len(h):].set(1.0)
+    say(f"[p{pid}] input: {len(tet)} tets -> {ndev} shards on "
+        f"{np_proc} processes")
+
+    t1 = time.time()
+    out, met_m, part = distributed_adapt_multi(
+        mesh, met, ndev, niter=2, cycles=4, verbose=2)
+    dt = time.time() - t1
+    tm = np.asarray(out.tmask)
+    q = np.asarray(tet_quality(out, met_m))[tm]
+    res = {
+        "processes": np_proc,
+        "devices": ndev,
+        "ntets_in": int(len(tet)),
+        "ntets_out": int(tm.sum()),
+        "qmin": round(float(q.min()), 4),
+        "qmean": round(float(q.mean()), 4),
+        "niter": 2,
+        "seconds": round(dt, 1),
+        "pipeline": "split->adapt->band-migrate->weld->merge",
+    }
+    say(f"[p{pid}] done: {json.dumps(res)}")
+    if pid == 0:
+        print(json.dumps(res))
+    log.close()
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--np", type=int, default=2)
+    ap.add_argument("--devices", type=int, default=4)
+    ap.add_argument("--n", type=int, default=4)
+    ap.add_argument("--timeout", type=int, default=3600)
+    args = ap.parse_args()
+
+    port = free_port()
+    procs = []
+    for pid in range(args.np):
+        env = dict(os.environ)
+        env.update({
+            "JAX_PLATFORMS": "cpu",
+            "XLA_FLAGS": (env.get("XLA_FLAGS", "") +
+                          " --xla_force_host_platform_device_count="
+                          f"{args.devices // args.np}").strip(),
+            "JAX_COORDINATOR_ADDRESS": f"127.0.0.1:{port}",
+            "JAX_NUM_PROCESSES": str(args.np),
+            "JAX_PROCESS_ID": str(pid),
+            "MH_WORKER": "1",
+            "MH_N": str(args.n),
+            "MH_DEVICES": str(args.devices),
+            # drop any sitecustomize TPU-tunnel backend: compiles must
+            # stay process-local on the CPU backend
+            "PYTHONPATH": os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__))),
+        })
+        procs.append(subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__)], env=env,
+            stdout=subprocess.PIPE if pid == 0 else subprocess.DEVNULL,
+            stderr=sys.stderr if pid == 0 else subprocess.DEVNULL))
+    rc = 0
+    out0 = b""
+    deadline = time.time() + args.timeout
+    try:
+        for pid, p in enumerate(procs):
+            rem = max(1, deadline - time.time())
+            o, _ = p.communicate(timeout=rem)
+            if pid == 0:
+                out0 = o or b""
+            rc = rc or p.returncode
+    except subprocess.TimeoutExpired:
+        for p in procs:
+            p.kill()
+        print("multihost_run: TIMEOUT", file=sys.stderr)
+        sys.exit(2)
+    sys.stdout.write(out0.decode())
+    sys.exit(rc)
+
+
+if __name__ == "__main__":
+    if os.environ.get("MH_WORKER") == "1":
+        worker()
+    else:
+        main()

@@ -229,3 +229,60 @@ def test_distributed_input_adopts_partition(tmp_path):
     n1 = int((part == 1).sum())
     assert np.array_equal(seen["part"],
                           np.repeat([0, 1], [n0, n1]))
+
+
+def test_vtu_reader_roundtrip(tmp_path):
+    """write_vtu -> read_vtu_medit round-trips geometry + metric
+    (PMMG_loadVtuMesh_centralized role, inoutcpp_pmmg.cpp:44)."""
+    from parmmg_tpu.io.vtk import read_vtu_medit
+    vert, tet = cube_mesh(2)
+    met = np.linspace(0.2, 0.5, len(vert))
+    p = write_vtu(tmp_path / "in.vtu", vert, tet,
+                  point_data={"metric": met},
+                  cell_data={"ref": np.arange(len(tet), dtype=float)})
+    m, met_r, fields = read_vtu_medit(p)
+    assert np.allclose(m.vert, vert)
+    assert (m.tetra == tet).all()
+    assert np.allclose(met_r, met)
+    assert (m.tref == np.arange(len(tet))).all()
+    assert fields == {}
+
+
+def test_cli_vtu_input(tmp_path):
+    """End-to-end: -in cube.vtu (metric in point data) adapts and writes
+    the medit output."""
+    from parmmg_tpu.io.vtk import write_vtu
+    vert, tet = cube_mesh(2)
+    p = write_vtu(tmp_path / "cube.vtu", vert, tet,
+                  point_data={"metric": np.full(len(vert), 0.4)})
+    out = tmp_path / "out.mesh"
+    rc = cli_main(["-in", str(p), "-out", str(out), "-niter", "1", "-v",
+                   "-1"])
+    assert rc == 0
+    mo = medit.read_mesh(out)
+    assert len(mo.tetra) > 0
+
+
+def test_parsop_edge_locals(tmp_path):
+    """A parsop file with an Edges entry clamps sizes on the user edge's
+    vertices (MMG3D_parsop edge-kind locals)."""
+    from parmmg_tpu.api import ParMesh
+    vert, tet = cube_mesh(2)
+    pm = ParMesh()
+    pm.set_mesh_size(np_=len(vert), ne=len(tet), na=1)
+    pm.set_vertices(vert)
+    pm.set_tetrahedra(tet + 1)
+    # one user edge along the bottom x-axis, ref 7
+    i0 = int(np.where((vert == [0, 0, 0]).all(1))[0][0])
+    i1 = int(np.where((np.isclose(vert[:, 0], 0.5))
+                      & (vert[:, 1] == 0) & (vert[:, 2] == 0))[0][0])
+    pm.set_edges(np.array([[i0 + 1, i1 + 1]]), np.array([7]))
+    pm.set_met_size(1, len(vert))
+    pm.set_scalar_mets(np.full(len(vert), 0.45))
+    pm.set_local_parameter(3, 7, 0.05, 0.12, 0.01)
+    from parmmg_tpu.driver import build_metric
+    mesh, met0 = pm._build_core_mesh()
+    met = np.asarray(build_metric(mesh, met0, pm.info))
+    assert met[i0] <= 0.12 + 1e-9 and met[i1] <= 0.12 + 1e-9
+    others = np.setdiff1d(np.arange(len(vert)), [i0, i1])
+    assert (met[others] > 0.12).any()
