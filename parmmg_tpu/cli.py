@@ -188,6 +188,29 @@ def main(argv=None) -> int:
             pm.set_ith_sol_in_sols_at_vertices(
                 i + 1, chunk if w > 1 else chunk[:, 0])
             off += w
+    elif vtu_fields:
+        # non-metric VTU point fields ride along as solution fields
+        # (the reference's loadVtu path carries them; losing them
+        # silently would strand the user's data) — scalar and
+        # 3-component fields map to the Medit sol types, anything else
+        # is skipped with a warning
+        from .io.medit import SOL_SCALAR, SOL_VECTOR
+        carried, types = [], []
+        for nm, arr in vtu_fields.items():
+            a = np.asarray(arr, np.float64).reshape(len(m.vert), -1)
+            if a.shape[1] == 1:
+                carried.append(a[:, 0])
+                types.append(SOL_SCALAR)
+            elif a.shape[1] == 3:
+                carried.append(a)
+                types.append(SOL_VECTOR)
+            else:
+                print(f"warning: dropping VTU point field '{nm}' "
+                      f"({a.shape[1]} components)", file=sys.stderr)
+        if carried:
+            pm.set_sols_at_vertices_size(len(types), types)
+            for i, chunk in enumerate(carried):
+                pm.set_ith_sol_in_sols_at_vertices(i + 1, chunk)
 
     # parameters
     info = pm.info

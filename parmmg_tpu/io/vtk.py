@@ -169,8 +169,18 @@ def read_vtu_medit(path: str | Path):
 
     def refs_for(t, n):
         for nm in ("medit:ref", "ref", "MaterialID", "CellEntityIds"):
-            if nm in cdata and len(order.get(t, ())) and \
-                    len(cdata[nm]) >= len(order[t]):
+            if nm in cdata and len(order.get(t, ())):
+                # order[t] holds row indices into the FULL cell
+                # sequence: the array must cover its MAX index, not
+                # just this type's count (a per-type-length array from
+                # a mixed-cell producer would otherwise fancy-index
+                # out of range)
+                if len(cdata[nm]) <= int(np.max(order[t])):
+                    raise ValueError(
+                        f"CellData '{nm}' has {len(cdata[nm])} values "
+                        f"but the file's cell list references index "
+                        f"{int(np.max(order[t]))} — per-type cell-data "
+                        "arrays are not supported")
                 v = np.asarray(cdata[nm])[order[t]]
                 if v.ndim == 1:
                     return v.astype(np.int32)

@@ -89,12 +89,18 @@ def adapt_cycle_impl(mesh: Mesh, met: jax.Array, wave: jax.Array,
     per-cycle counters with a single transfer (each separate scalar pull
     costs a full round trip on a remote-device transport, and an *eager*
     count op on the host would fight the donated input buffers).
-    ``deferred`` = any wave cut viable candidates at its top-K budget
-    (the narrow path's entry precondition is a False here);
-    ``narrow_abort`` is always 0 on this full-width path.
+    ``deferred`` = top-K budget cuts of viable candidates, encoded as
+    2 bits: bit 0 = an INSERTION wave (split/collapse) deferred —
+    sizing-critical, the narrow path escalates to full-width on it;
+    bit 1 = a SWAP wave deferred — swap nomination pools routinely
+    exceed the sub top-K and their backlog is covered by the periodic
+    full refresh + polish, so narrow does not escalate on it
+    (ops/active.py).  ``narrow_abort`` is always 0 on this full-width
+    path.
     """
     from .adjacency import boundary_edge_tags
     defer = jnp.zeros((), bool)
+    defer_sw = jnp.zeros((), bool)
     if do_insert:
         # ONE edge table + metric lengths serve both split and collapse
         # (the tables are a measured wave hot spot); the collapse defers
@@ -159,7 +165,7 @@ def adapt_cycle_impl(mesh: Mesh, met: jax.Array, wave: jax.Array,
         s23 = swap23_wave(mesh, met, budget_div=budget_div, wwin=wwin)
         mesh = s23.mesh
         nswap = sew.nswap + s23.nswap
-        defer = defer | sew.deferred | s23.deferred
+        defer_sw = defer_sw | sew.deferred | s23.deferred
 
     nmoved = jnp.zeros((), jnp.int32)
     if do_smooth:
@@ -179,7 +185,8 @@ def adapt_cycle_impl(mesh: Mesh, met: jax.Array, wave: jax.Array,
     counts = jnp.stack([nsplit, ncol, nswap, nmoved,
                         overflow.astype(jnp.int32),
                         jnp.sum(mesh.tmask, dtype=jnp.int32),
-                        defer.astype(jnp.int32),
+                        defer.astype(jnp.int32)
+                        + 2 * defer_sw.astype(jnp.int32),
                         jnp.zeros((), jnp.int32)])
     return mesh, met, counts
 

@@ -52,10 +52,29 @@ def morton_window_mask(vert: jax.Array, vmask: jax.Array, wave,
     COHERENT subsets keeps each cycle's footprint a compact blob, which
     is what lets the active-scoped narrow path (ops/active.py) hold the
     worklist small — scattered moves have ~100-tet 2-hop stencils each,
-    a window's moves share theirs."""
+    a window's moves share theirs.
+
+    Windows are equal-POPULATION segments of the curve, not equal
+    code-space: an adapted mesh concentrates vertices where the metric
+    is fine (the shock slab holds most of the mesh), so code-space
+    windows made per-cycle footprints oscillate severalfold and
+    overflow the narrow row budget (measured 8k-21k active tets at
+    nwin=24; each overflow costs a discarded narrow attempt plus a
+    full-width fallback cycle).  The live-vertex histogram CDF over
+    1024 curve bins equalizes the windows to bin granularity for the
+    cost of one [capP] scatter-add.  Window boundaries therefore DRIFT
+    as the population changes; the bounded-staleness guarantee of the
+    worklist does not rest on stable boundaries but on the periodic
+    full-width refresh cycle (ops/active.py module docstring)."""
     from .edges import morton_codes
     code = morton_codes(vert, vmask, bits=5)   # 15-bit morton
-    win = (code * nwin) // 32768
+    b = code >> 5                              # 1024 curve bins
+    hist = jnp.zeros(1024, jnp.int32).at[b].add(
+        vmask.astype(jnp.int32), mode="drop")
+    cdf = jnp.cumsum(hist)
+    n_live = jnp.maximum(cdf[-1], 1)
+    rank0 = (cdf - hist)[b]                    # live rank at bin start
+    win = (rank0 * nwin) // n_live             # <= capP * 64 < int31
     return win == jnp.mod(jnp.asarray(wave, jnp.int32), nwin)
 
 

@@ -43,6 +43,37 @@ def how_many_groups(ne: int, target: int) -> int:
     return max(1, min((ne + target - 1) // target, C.REMESHER_NGRPS_MAX))
 
 
+def group_chunk(ngroups: int) -> int:
+    """Groups per dispatch (0 = all in one ``lax.map``).
+
+    On the tunneled TPU a single dispatch spanning every group runs for
+    minutes (43 groups x fused cycle block) and the tunnel kills the
+    worker mid-execution ("TPU worker process crashed"; reproduced
+    rounds 3-4 at the 1M-tet scale).  Chunking the map axis bounds each
+    dispatch to ~chunk group-blocks (~10-20 s) — same compiled program
+    per chunk, same results — at the cost of one counter pull per
+    chunk.  Elsewhere (CPU tests) chunking buys nothing: default 0.
+    Override with PARMMG_GROUP_CHUNK."""
+    import os
+    v = os.environ.get("PARMMG_GROUP_CHUNK", "")
+    if v:
+        return max(0, int(v))
+    return 8 if jax.default_backend() == "tpu" else 0
+
+
+def _pad_groups(tree, g_new: int):
+    """Pad a stacked pytree's leading group axis to ``g_new`` with dead
+    groups (all-zero arrays: masks False, counts 0 — every wave kernel
+    is a no-op on a fully-dead mesh)."""
+    def pad(a):
+        g = a.shape[0]
+        if g >= g_new:
+            return a
+        return jnp.concatenate(
+            [a, jnp.zeros((g_new - g,) + a.shape[1:], a.dtype)])
+    return jax.tree.map(pad, tree)
+
+
 def grouped_adapt_pass(mesh: Mesh, met, ngroups: int, cycles: int = 12,
                        part: np.ndarray | None = None,
                        verbose: int = 0, stats=None,
@@ -65,8 +96,58 @@ def grouped_adapt_pass(mesh: Mesh, met, ngroups: int, cycles: int = 12,
     if part is None:
         cent = vert_h[tet_h].mean(axis=1)
         part = fix_contiguity(tet_h, morton_partition(cent, ngroups))
-    stacked, met_s = split_to_shards(mesh, met, part, ngroups,
-                                     cap_mult=3.0)
+
+    # chunked dispatch (group_chunk docstring): pad the group axis so
+    # every chunk runs the SAME compiled [chunk,...] program.  In chunk
+    # mode the stacked state lives in HOST RAM between dispatches and
+    # only the in-flight chunk occupies HBM — the zaldy_pmmg.c memory
+    # philosophy at chip scale: this is what bounds peak HBM by the
+    # CHUNK, not the mesh (a device-resident 43-group state OOMed the
+    # 16 GB chip mid-polish at the 1M-tet scale, 2026-08-02), and what
+    # makes the 10M-tet configuration fit.  The split itself is staged
+    # on the CPU backend for the same reason: split_to_shards runs a
+    # per-shard adjacency program and stacks the result, which would
+    # otherwise materialize the WHOLE stacked state in HBM.
+    chunk = group_chunk(ngroups)
+    if chunk and chunk < ngroups:
+        cpu = jax.local_devices(backend="cpu")[0]
+        with jax.default_device(cpu):
+            stacked, met_s = split_to_shards(mesh, met, part, ngroups,
+                                             cap_mult=3.0)
+            g_exec = -(-ngroups // chunk) * chunk
+            # np.array (copy): np.asarray of a jax array can hand back
+            # a READ-ONLY buffer, and the host state is mutated in
+            # place by the per-chunk writebacks
+            stacked = jax.tree.map(
+                lambda a: np.array(a), _pad_groups(stacked, g_exec))
+            met_s = np.array(_pad_groups(met_s, g_exec))
+    else:
+        chunk = 0
+        g_exec = ngroups
+        stacked, met_s = split_to_shards(mesh, met, part, ngroups,
+                                         cap_mult=3.0)
+
+    def _assign(dst_tree, src_tree, g0):
+        """Write a chunk's device results back into the host state."""
+        def w(d, s):
+            d[g0:g0 + chunk] = np.asarray(s)
+            return d
+        jax.tree.map(w, dst_tree, src_tree)
+
+    def _run_chunked(fn, stacked, met_s, wave):
+        """Apply a per-chunk jitted block over the group axis."""
+        if not chunk:
+            return fn(stacked, met_s, wave)
+        cs = []
+        for g0 in range(0, g_exec, chunk):
+            sl = jax.tree.map(lambda a: jnp.asarray(a[g0:g0 + chunk]),
+                              stacked)
+            kl = jnp.asarray(met_s[g0:g0 + chunk])
+            m, k, cnt = fn(sl, kl, wave)
+            _assign(stacked, m, g0)
+            met_s[g0:g0 + chunk] = np.asarray(k)
+            cs.append(np.asarray(cnt))
+        return stacked, met_s, np.concatenate(cs)
 
     def one_block(flags: tuple):
         # fused cycle block inside the lax.map body: one dispatch + one
@@ -85,7 +166,8 @@ def grouped_adapt_pass(mesh: Mesh, met, ngroups: int, cycles: int = 12,
 
         @jax.jit
         def run(stacked, met_s, wave):
-            waves = jnp.full(ngroups, wave, jnp.int32)
+            n_map = stacked.vert.shape[0]            # chunk or g_exec
+            waves = jnp.full(n_map, wave, jnp.int32)
             m, k, counts = jax.lax.map(body, (stacked, met_s, waves))
             return m, k, counts                      # counts [G, n, 6]
 
@@ -101,8 +183,8 @@ def grouped_adapt_pass(mesh: Mesh, met, ngroups: int, cycles: int = 12,
                       for cc in range(c, c + nblk))
         if flags not in steps:
             steps[flags] = one_block(flags)
-        stacked, met_s, counts = steps[flags](stacked, met_s,
-                                              jnp.asarray(c, jnp.int32))
+        stacked, met_s, counts = _run_chunked(
+            steps[flags], stacked, met_s, jnp.asarray(c, jnp.int32))
         cs = np.asarray(counts).sum(axis=0)       # [n, 6] over groups
         for i in range(nblk):
             tot = cs[i]
@@ -121,8 +203,35 @@ def grouped_adapt_pass(mesh: Mesh, met, ngroups: int, cycles: int = 12,
                 raise MemoryError("group capacity exhausted")
             capP = stacked.vert.shape[1]
             capT = stacked.tet.shape[1]
-            stacked, met_s = grow_shards(stacked, met_s, 2 * capP,
-                                         2 * capT)
+            if chunk:
+                # host-resident grow (np.pad mirror of grow_shards —
+                # jnp.pad would re-materialize the state on device)
+                import dataclasses as _dc
+
+                def _padP(x, fill=0):
+                    pad = [(0, 0)] * x.ndim
+                    pad[1] = (0, capP)
+                    return np.pad(x, pad, constant_values=fill)
+
+                def _padT(x, fill=0):
+                    pad = [(0, 0)] * x.ndim
+                    pad[1] = (0, capT)
+                    return np.pad(x, pad, constant_values=fill)
+
+                stacked = _dc.replace(
+                    stacked,
+                    vert=_padP(stacked.vert), vref=_padP(stacked.vref),
+                    vtag=_padP(stacked.vtag),
+                    vmask=_padP(stacked.vmask, False),
+                    tet=_padT(stacked.tet), tref=_padT(stacked.tref),
+                    tmask=_padT(stacked.tmask, False),
+                    adja=_padT(stacked.adja, -1),
+                    ftag=_padT(stacked.ftag), fref=_padT(stacked.fref),
+                    etag=_padT(stacked.etag))
+                met_s = _padP(met_s)
+            else:
+                stacked, met_s = grow_shards(stacked, met_s, 2 * capP,
+                                             2 * capT)
             regrows += 1
             continue        # re-run the block: truncated winners rerun
         c += nblk
@@ -148,19 +257,40 @@ def grouped_adapt_pass(mesh: Mesh, met, ngroups: int, cycles: int = 12,
                     do_swap=not noswap, do_smooth=not nomove,
                     hausd=hausd)
                 return m, k, cnt
-            waves = jnp.full(ngroups, wave, jnp.int32)
+            n_map = stacked.vert.shape[0]            # chunk or g_exec
+            waves = jnp.full(n_map, wave, jnp.int32)
             m, k, cnt = jax.lax.map(body, (stacked, met_s, waves))
             return m, k, cnt
 
-        for w in range(4):
-            stacked, met_s, cnt = polish_block(
-                stacked, met_s, jnp.asarray(2000 + w, jnp.int32))
-            tot = np.asarray(cnt).sum(axis=0)
-            if verbose >= 2:
-                print(f"  grp polish {w}: collapse {int(tot[0])} "
-                      f"swap {int(tot[1])} move {int(tot[2])}")
-            if int(tot[0]) == 0 and int(tot[1]) == 0:
-                break
+        if chunk:
+            # per-chunk wave loop: each chunk polishes to ITS quiet
+            # point while resident, one upload/download per chunk total
+            for g0 in range(0, g_exec, chunk):
+                sl = jax.tree.map(
+                    lambda a: jnp.asarray(a[g0:g0 + chunk]), stacked)
+                kl = jnp.asarray(met_s[g0:g0 + chunk])
+                for w in range(4):
+                    sl, kl, cnt = polish_block(
+                        sl, kl, jnp.asarray(2000 + w, jnp.int32))
+                    tot = np.asarray(cnt).sum(axis=0)
+                    if verbose >= 2:
+                        print(f"  grp polish chunk {g0 // chunk} w{w}: "
+                              f"collapse {int(tot[0])} swap "
+                              f"{int(tot[1])} move {int(tot[2])}")
+                    if int(tot[0]) == 0 and int(tot[1]) == 0:
+                        break
+                _assign(stacked, sl, g0)
+                met_s[g0:g0 + chunk] = np.asarray(kl)
+        else:
+            for w in range(4):
+                stacked, met_s, cnt = polish_block(
+                    stacked, met_s, jnp.asarray(2000 + w, jnp.int32))
+                tot = np.asarray(cnt).sum(axis=0)
+                if verbose >= 2:
+                    print(f"  grp polish {w}: collapse {int(tot[0])} "
+                          f"swap {int(tot[1])} move {int(tot[2])}")
+                if int(tot[0]) == 0 and int(tot[1]) == 0:
+                    break
     return merge_shards(stacked, met_s, return_part=True)
 
 

@@ -93,11 +93,23 @@ def shard_stacked_global(stacked_host, dmesh):
     devs = list(dmesh.devices.reshape(-1))
 
     def put(x):
+        if isinstance(x, jax.Array) and not x.is_fully_addressable:
+            # already a multi-process global array (e.g. the output of
+            # grow_shards' pad on a sharded input): np.asarray would
+            # raise on non-addressable shards — reshard with a jitted
+            # identity instead (XLA inserts the collectives)
+            return jax.jit(lambda a: a, out_shardings=sh)(x)
         x = np.asarray(x)
+        if x.shape[0] % len(devs):
+            raise ValueError(
+                f"leading axis {x.shape[0]} not divisible by "
+                f"{len(devs)} devices (groups x shards requires "
+                "G whole rows per device)")
+        g = x.shape[0] // len(devs)   # logical shards per device (G)
         pieces = []
         for i, d in enumerate(devs):
             if d.process_index == jax.process_index():
-                pieces.append(jax.device_put(x[i][None], d))
+                pieces.append(jax.device_put(x[i * g:(i + 1) * g], d))
         return jax.make_array_from_single_device_arrays(
             x.shape, sh, pieces)
 

@@ -41,6 +41,36 @@ def test_grouped_adapt_conforming():
     assert q.min() > 0.02
 
 
+def test_grouped_chunked_matches_unchunked(monkeypatch):
+    """Chunked group dispatch (group_chunk: the tunnel-safe bounded
+    dispatch) must produce the same mesh as one lax.map over all
+    groups: the per-group program is identical, chunking only changes
+    how many groups one dispatch covers, and the dead pad groups are
+    no-ops."""
+    from parmmg_tpu.parallel.groups import grouped_adapt_pass
+
+    vert, tet = cube_mesh(3)
+
+    def run():
+        m = make_mesh(vert, tet, capP=4 * len(vert), capT=4 * len(tet))
+        m = analyze_mesh(m).mesh
+        met = jnp.full(m.capP, 0.35, m.vert.dtype)
+        out, met2, _ = grouped_adapt_pass(m, met, 4, cycles=2)
+        return out
+
+    monkeypatch.setenv("PARMMG_GROUP_CHUNK", "0")
+    ref = run()
+    # chunk=3 on 4 groups: pads to 6 with 2 dead groups
+    monkeypatch.setenv("PARMMG_GROUP_CHUNK", "3")
+    chk = run()
+    tm_r, tm_c = np.asarray(ref.tmask), np.asarray(chk.tmask)
+    assert tm_r.sum() == tm_c.sum()
+    assert (np.asarray(ref.tet)[tm_r] == np.asarray(chk.tet)[tm_c]).all()
+    vr = np.asarray(ref.vert)[np.asarray(ref.vmask)]
+    vc = np.asarray(chk.vert)[np.asarray(chk.vmask)]
+    assert vr.shape == vc.shape and (vr == vc).all()
+
+
 def test_mesh_size_engages_groups():
     """Setting IParam.meshSize below the mesh size must route the
     single-device run through the grouped path."""

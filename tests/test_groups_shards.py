@@ -66,13 +66,23 @@ def test_grouped_placement_matches_flat():
     tm_f = np.asarray(out_f.tmask)
     tm_g = np.asarray(out_g.tmask)
     assert tm_f.sum() == tm_g.sum()
-    # same live tet SET (order may differ by placement): compare sorted
-    # coordinate-key multisets
+    # same live tet SET (order may differ by placement): canonicalize
+    # each tet as its vertex-coordinate rows sorted WITHIN the tet,
+    # then lexsort whole 12-tuples — a true row-multiset comparison
+    # (sorting each column independently would destroy row association
+    # and could equate different meshes)
     vf, tf, _, _, _ = mesh_to_host(out_f)
     vg, tg, _, _, _ = mesh_to_host(out_g)
-    kf = np.sort(np.sort(vf[tf].reshape(len(tf), 12), axis=1), axis=0)
-    kg = np.sort(np.sort(vg[tg].reshape(len(tg), 12), axis=1), axis=0)
-    assert np.allclose(kf, kg, atol=1e-12)
+
+    def canon(v, t):
+        corners = v[t]                       # [n, 4, 3]
+        order = np.lexsort((corners[:, :, 2], corners[:, :, 1],
+                            corners[:, :, 0]), axis=1)
+        rows = np.take_along_axis(corners, order[:, :, None],
+                                  axis=1).reshape(len(t), 12)
+        return rows[np.lexsort(rows.T[::-1])]
+
+    assert np.allclose(canon(vf, tf), canon(vg, tg), atol=1e-12)
     assert (np.sort(part_f) == np.sort(part_g)).all()
 
 
