@@ -194,7 +194,7 @@ def main(argv=None) -> int:
         # silently would strand the user's data) — scalar and
         # 3-component fields map to the Medit sol types, anything else
         # is skipped with a warning
-        from .io.medit import SOL_SCALAR, SOL_VECTOR
+        from .io.medit import SOL_SCALAR, SOL_VECTOR, SOL_TENSOR
         carried, types = [], []
         for nm, arr in vtu_fields.items():
             a = np.asarray(arr, np.float64).reshape(len(m.vert), -1)
@@ -204,6 +204,9 @@ def main(argv=None) -> int:
             elif a.shape[1] == 3:
                 carried.append(a)
                 types.append(SOL_VECTOR)
+            elif a.shape[1] == 6:
+                carried.append(a)
+                types.append(SOL_TENSOR)
             else:
                 print(f"warning: dropping VTU point field '{nm}' "
                       f"({a.shape[1]} components)", file=sys.stderr)

@@ -78,6 +78,11 @@ class Mesh:
         return int(jnp.sum(self.vmask)), int(jnp.sum(self.tmask))
 
 
+# canonical field-name tuple for (de)serializing a Mesh as flat arrays
+# (npz state handoffs: scripts/scale_big.py, parallel/_polish_worker.py)
+MESH_FIELDS = tuple(f.name for f in dataclasses.fields(Mesh))
+
+
 def make_mesh(vert: np.ndarray, tet: np.ndarray,
               vref: np.ndarray | None = None,
               tref: np.ndarray | None = None,

@@ -226,7 +226,7 @@ def parmmg_run(pm) -> tuple[Mesh, object, AdaptStats]:
                                      and info.nomove):
                 from .ops.adapt import sliver_polish
                 with tim("bad-element polish"):
-                    for w in range(4):
+                    for w in range(8):
                         mesh, counts = sliver_polish(
                             mesh, met, jnp.asarray(1000 + w, jnp.int32),
                             do_collapse=not info.noinsert,
@@ -323,7 +323,7 @@ def parmmg_run(pm) -> tuple[Mesh, object, AdaptStats]:
             from .ops.adapt import sliver_polish
             import jax.numpy as jnp
             with tim("bad-element polish"):
-                for w in range(4):
+                for w in range(8):
                     mesh, counts = sliver_polish(
                         mesh, met, jnp.asarray(1000 + w, jnp.int32),
                         do_collapse=not info.noinsert,

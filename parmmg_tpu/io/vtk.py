@@ -168,22 +168,29 @@ def read_vtu_medit(path: str | Path):
     m.vref = np.zeros(len(vert), np.int32)
 
     def refs_for(t, n):
+        short = []
         for nm in ("medit:ref", "ref", "MaterialID", "CellEntityIds"):
             if nm in cdata and len(order.get(t, ())):
                 # order[t] holds row indices into the FULL cell
                 # sequence: the array must cover its MAX index, not
                 # just this type's count (a per-type-length array from
                 # a mixed-cell producer would otherwise fancy-index
-                # out of range)
+                # out of range).  A short array is skipped in favor of
+                # the next candidate name (the pre-existing fallthrough
+                # contract); only if NO candidate is usable does the
+                # ambiguity become a hard error instead of silently
+                # zeroed refs.
                 if len(cdata[nm]) <= int(np.max(order[t])):
-                    raise ValueError(
-                        f"CellData '{nm}' has {len(cdata[nm])} values "
-                        f"but the file's cell list references index "
-                        f"{int(np.max(order[t]))} — per-type cell-data "
-                        "arrays are not supported")
+                    short.append(nm)
+                    continue
                 v = np.asarray(cdata[nm])[order[t]]
                 if v.ndim == 1:
                     return v.astype(np.int32)
+        if short:
+            raise ValueError(
+                f"CellData {short} shorter than the file's cell list "
+                "(per-type cell-data arrays are not supported) and no "
+                "full-length ref array is present")
         return np.zeros(n, np.int32)
 
     if _VTK_TETRA in cells:

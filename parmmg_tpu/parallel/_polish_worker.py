@@ -1,0 +1,81 @@
+"""Subprocess worker: grouped sliver polish from a host-state file.
+
+Why a subprocess: at the >=1M-tet scale the tunneled TPU worker
+reliably dies when the grouped polish program is compiled/dispatched
+LATE in a session that already ran the full grouped sizing phase
+(reproduced twice on 2026-08-02: device-resident state OOMs, chunked
+state kernel-faults — while the identical polish program compiles and
+runs fine in a fresh client).  Running the polish phase in its own
+process gives it a fresh tunnel client and bounds the blast radius:
+a crash here costs the quality tail, not the run (the caller treats a
+non-zero exit as "skip grouped polish" and falls back to the merged
+CPU polish).
+
+Protocol: argv[1] = input .npz (stacked Mesh leaves + met + knobs),
+argv[2] = output .npz (updated tet-axis leaves + met).  Invoked by
+``parallel.groups.grouped_adapt_pass`` via ``sys.executable -m``.
+"""
+from __future__ import annotations
+
+import dataclasses
+import sys
+
+import numpy as np
+
+from ..core.mesh import MESH_FIELDS
+
+
+def main(inp: str, outp: str) -> None:
+    import jax
+    import jax.numpy as jnp
+    from ..core.mesh import Mesh
+    from ..ops.adapt import sliver_polish_impl
+
+    z = np.load(inp)
+    stacked = Mesh(**{f: z[f] for f in MESH_FIELDS})
+    met_s = z["met"]
+    chunk = int(z["chunk"])
+    noinsert, noswap, nomove = (bool(z["noinsert"]), bool(z["noswap"]),
+                                bool(z["nomove"]))
+    hausd = float(z["hausd"]) if np.isfinite(z["hausd"]) else None
+    g_exec = stacked.vert.shape[0]
+    met_s = np.array(met_s)
+    stacked = dataclasses.replace(
+        stacked, **{f: np.array(getattr(stacked, f))
+                    for f in MESH_FIELDS})
+
+    @jax.jit
+    def polish_block(stacked, met_s, wave):
+        def body(args):
+            m, k, w = args
+            m, cnt = sliver_polish_impl(
+                m, k, w, do_collapse=not noinsert, do_swap=not noswap,
+                do_smooth=not nomove, hausd=hausd)
+            return m, k, cnt
+        waves = jnp.full(stacked.vert.shape[0], wave, jnp.int32)
+        return jax.lax.map(body, (stacked, met_s, waves))
+
+    for g0 in range(0, g_exec, chunk):
+        sl = jax.tree.map(lambda a: jnp.asarray(a[g0:g0 + chunk]),
+                          stacked)
+        kl = jnp.asarray(met_s[g0:g0 + chunk])
+        for w in range(4):
+            sl, kl, cnt = polish_block(sl, kl,
+                                       jnp.asarray(2000 + w, jnp.int32))
+            tot = np.asarray(cnt).sum(axis=0)
+            print(f"polish chunk {g0 // chunk} w{w}: "
+                  f"collapse {int(tot[0])} swap {int(tot[1])} "
+                  f"move {int(tot[2])}", file=sys.stderr, flush=True)
+            if int(tot[0]) == 0 and int(tot[1]) == 0:
+                break
+        for f in MESH_FIELDS:
+            getattr(stacked, f)[g0:g0 + chunk] = np.asarray(
+                getattr(sl, f))
+        met_s[g0:g0 + chunk] = np.asarray(kl)
+
+    np.savez(outp, met=met_s,
+             **{f: getattr(stacked, f) for f in MESH_FIELDS})
+
+
+if __name__ == "__main__":
+    main(sys.argv[1], sys.argv[2])

@@ -43,6 +43,18 @@ def how_many_groups(ne: int, target: int) -> int:
     return max(1, min((ne + target - 1) // target, C.REMESHER_NGRPS_MAX))
 
 
+def _polish_subproc() -> bool:
+    """Whether the grouped polish phase runs in its own process
+    (PARMMG_POLISH_SUBPROC; default: only on the tunneled TPU, where
+    the in-session polish dispatch reliably kills the worker — see
+    parallel/_polish_worker.py)."""
+    import os
+    v = os.environ.get("PARMMG_POLISH_SUBPROC", "")
+    if v:
+        return v != "0"
+    return jax.default_backend() == "tpu"
+
+
 def group_chunk(ngroups: int) -> int:
     """Groups per dispatch (0 = all in one ``lax.map``).
 
@@ -53,12 +65,13 @@ def group_chunk(ngroups: int) -> int:
     dispatch to ~chunk group-blocks (~10-20 s) — same compiled program
     per chunk, same results — at the cost of one counter pull per
     chunk.  Elsewhere (CPU tests) chunking buys nothing: default 0.
-    Override with PARMMG_GROUP_CHUNK."""
+    Returns 0 (unchunked) when the chunk would cover every group
+    anyway.  Override with PARMMG_GROUP_CHUNK."""
     import os
     v = os.environ.get("PARMMG_GROUP_CHUNK", "")
-    if v:
-        return max(0, int(v))
-    return 8 if jax.default_backend() == "tpu" else 0
+    c = max(0, int(v)) if v else (
+        8 if jax.default_backend() == "tpu" else 0)
+    return 0 if c >= ngroups else c
 
 
 def _pad_groups(tree, g_new: int):
@@ -79,7 +92,7 @@ def grouped_adapt_pass(mesh: Mesh, met, ngroups: int, cycles: int = 12,
                        verbose: int = 0, stats=None,
                        noinsert: bool = False, noswap: bool = False,
                        nomove: bool = False, hausd: float | None = None,
-                       polish: bool = False):
+                       polish: bool = False, cap_mult: float = 3.0):
     """One outer pass: split into groups, run adapt cycles with lax.map
     over the group axis, merge.  Returns (mesh, met, part_of_merged).
 
@@ -109,11 +122,11 @@ def grouped_adapt_pass(mesh: Mesh, met, ngroups: int, cycles: int = 12,
     # per-shard adjacency program and stacks the result, which would
     # otherwise materialize the WHOLE stacked state in HBM.
     chunk = group_chunk(ngroups)
-    if chunk and chunk < ngroups:
+    if chunk:
         cpu = jax.local_devices(backend="cpu")[0]
         with jax.default_device(cpu):
             stacked, met_s = split_to_shards(mesh, met, part, ngroups,
-                                             cap_mult=3.0)
+                                             cap_mult=cap_mult)
             g_exec = -(-ngroups // chunk) * chunk
             # np.array (copy): np.asarray of a jax array can hand back
             # a READ-ONLY buffer, and the host state is mutated in
@@ -125,7 +138,7 @@ def grouped_adapt_pass(mesh: Mesh, met, ngroups: int, cycles: int = 12,
         chunk = 0
         g_exec = ngroups
         stacked, met_s = split_to_shards(mesh, met, part, ngroups,
-                                         cap_mult=3.0)
+                                         cap_mult=cap_mult)
 
     def _assign(dst_tree, src_tree, g0):
         """Write a chunk's device results back into the host state."""
@@ -262,7 +275,47 @@ def grouped_adapt_pass(mesh: Mesh, met, ngroups: int, cycles: int = 12,
             m, k, cnt = jax.lax.map(body, (stacked, met_s, waves))
             return m, k, cnt
 
-        if chunk:
+        if chunk and _polish_subproc():
+            # fresh-process polish (see _polish_worker module docstring:
+            # the tunnel worker reliably dies when this program lands
+            # late in a long session; a fresh client runs it fine).
+            # Non-fatal: on worker failure the grouped polish is
+            # skipped with a warning — the caller's merged polish +
+            # repair tail still runs.
+            import subprocess
+            import sys as _sys
+            import tempfile
+            from ..core.mesh import MESH_FIELDS
+            with tempfile.TemporaryDirectory() as td:
+                inp, outp = f"{td}/in.npz", f"{td}/out.npz"
+                np.savez(inp, met=met_s, chunk=chunk,
+                         noinsert=noinsert, noswap=noswap, nomove=nomove,
+                         hausd=(np.nan if hausd is None else hausd),
+                         **{f: getattr(stacked, f) for f in MESH_FIELDS})
+                import os as _os
+                env = dict(_os.environ)
+                pkg_parent = _os.path.dirname(_os.path.dirname(
+                    _os.path.dirname(_os.path.abspath(__file__))))
+                env["PYTHONPATH"] = (env.get("PYTHONPATH", "") +
+                                     _os.pathsep + pkg_parent).lstrip(
+                    _os.pathsep)
+                r = subprocess.run(
+                    [_sys.executable, "-m",
+                     "parmmg_tpu.parallel._polish_worker", inp, outp],
+                    stderr=subprocess.PIPE, text=True, env=env)
+                if r.returncode == 0:
+                    import dataclasses as _dc
+                    z = np.load(outp)
+                    stacked = _dc.replace(
+                        stacked, **{f: z[f] for f in MESH_FIELDS})
+                    met_s = z["met"]
+                    if verbose >= 2:
+                        print(r.stderr, end="")
+                else:
+                    print("grouped polish worker failed "
+                          f"(rc={r.returncode}); skipping grouped "
+                          "polish\n" + r.stderr[-2000:], file=_sys.stderr)
+        elif chunk:
             # per-chunk wave loop: each chunk polishes to ITS quiet
             # point while resident, one upload/download per chunk total
             for g0 in range(0, g_exec, chunk):
@@ -291,6 +344,13 @@ def grouped_adapt_pass(mesh: Mesh, met, ngroups: int, cycles: int = 12,
                           f"swap {int(tot[1])} move {int(tot[2])}")
                 if int(tot[0]) == 0 and int(tot[1]) == 0:
                     break
+    if chunk:
+        # merge on the CPU backend: merge_shards rebuilds adjacency at
+        # MERGED-mesh width — a whole-mesh device program that OOMs the
+        # chip at the >=1M-tet scale (same staging rule as the split)
+        cpu = jax.local_devices(backend="cpu")[0]
+        with jax.default_device(cpu):
+            return merge_shards(stacked, met_s, return_part=True)
     return merge_shards(stacked, met_s, return_part=True)
 
 

@@ -911,7 +911,11 @@ def repair_flood_labels(stacked: Mesh, labels_d, depth_d, n_shards: int,
     while KB < int(cnts.max()):
         KB *= 2
     KB = min(KB, capT)
-    cnt, rows, lab, dep, rtet, out_touch = jax.device_get(
+    # pull_host, not device_get: on a multi-process runtime the probe
+    # outputs are 'shard'-sharded global arrays (every process computes
+    # the identical host repair from the allgathered tables)
+    cnt, rows, lab, dep, rtet, out_touch = (
+        _pull(x) for x in
         flood_probe(stacked, labels_d, depth_d, n_shards, KB))
     new_lab = np.full((n_shards, KB), -1, np.int32)
     nfixed = 0
@@ -1065,8 +1069,10 @@ def graph_repartition_labels_band(stacked: Mesh, comms, n_shards: int,
         fi2 = np.full((fi.shape[0], Kn, If), -1, fi.dtype)
         fi2[:, :fi.shape[1], :fi.shape[2]] = fi
         fi = fi2
-    clus, nlive, cw, pcnt, cif = jax.device_get(graph_probe(
-        stacked, jnp.asarray(fi), S, G))
+    # pull_host, not device_get: multi-process-safe (every process
+    # allgathers the same O(S*G^2 + interface) tables)
+    clus, nlive, cw, pcnt, cif = (
+        _pull(x) for x in graph_probe(stacked, jnp.asarray(fi), S, G))
     nclu = S * G
     pi, pj, w = [], [], []
     for s in range(S):

@@ -1,22 +1,34 @@
 """Million-tet single-chip datapoint via the two-level group machinery.
 
 The 10M-tet configuration (BASELINE.md planned configs) is reachable on
-one chip only through sub-device groups: lax.map over group slots keeps
-the working set (and the O(n log^2 n) wave sorts) at GROUP size while
-the stacked state holds the whole mesh (parallel/groups.py, the
-grpsplit_pmmg.c:1551 role).  This script runs one grouped adaptation
-pass on a >=1M-tet shock cube and reports per-phase timings + the
-grouped throughput as ONE JSON line (same shape as bench.py).
+one chip only through sub-device groups: chunked ``lax.map`` over group
+slots keeps the working set (and the O(n log^2 n) wave sorts) at GROUP
+size while HOST RAM holds the whole mesh (parallel/groups.py, the
+grpsplit_pmmg.c:1551 role).  This script runs grouped adaptation passes
+on a >=1M-tet shock cube and reports per-phase timings + the grouped
+throughput as ONE JSON line (same shape as bench.py).
+
+Process layout: each grouped PASS runs in its own subprocess with a
+FRESH tunnel client (SCALE_WORKER=1 re-entry), with the merged mesh
+handed over via .npz.  Reproduced failure mode this avoids: the axon
+TPU worker reliably dies on the next BIG remote compile late in a
+session that already ran a full grouped pass (pass-2 regrow-shape
+compiles crashed 3/3 attempts on 2026-08-02, while identical programs
+compile fine in a fresh client).  The orchestrator itself pins
+JAX_PLATFORMS=cpu — only pass workers (and the nested polish worker,
+parallel/_polish_worker.py) touch the chip.
 
 Run (real chip): cd /root/repo && python scripts/scale_big.py
 Knobs: SCALE_N (default 56 -> 6*56^3 = 1,053,696 tets),
        SCALE_TARGET (group size target, default 24576),
-       SCALE_CYCLES (default 6), JAX_PLATFORMS=cpu for a CPU run.
+       SCALE_CYCLES (default 6), SCALE_NITER (passes, default 2),
+       SCALE_DEVICE=cpu to keep even the workers off the chip.
 """
 from __future__ import annotations
 
 import json
 import os
+import subprocess
 import sys
 import time
 
@@ -26,25 +38,86 @@ os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
 
 import numpy as np
 
+from parmmg_tpu.core.mesh import MESH_FIELDS
+
+
+def _save_state(path, mesh, met, part, extra=None):
+    np.savez(path, met=np.asarray(met), part=np.asarray(part),
+             **{f: np.asarray(getattr(mesh, f)) for f in MESH_FIELDS},
+             **(extra or {}))
+
+
+def _load_state(path):
+    from parmmg_tpu.core.mesh import Mesh
+    z = np.load(path)
+    mesh = Mesh(**{f: z[f] for f in MESH_FIELDS})
+    return z, mesh, z["met"], z["part"]
+
+
+def worker() -> None:
+    """One grouped pass on the accelerator (fresh process)."""
+    import jax
+    from parmmg_tpu.parallel.groups import grouped_adapt_pass
+    from parmmg_tpu.ops.adapt import AdaptStats
+
+    inp, outp = os.environ["SCALE_IN"], os.environ["SCALE_OUT"]
+    cycles = int(os.environ.get("SCALE_CYCLES", "6"))
+    polish = os.environ.get("SCALE_POLISH", "0") == "1"
+    vb = 3 if os.environ.get("SCALE_VERBOSE") else 0
+    z, mesh, met, part = _load_state(inp)
+    ngroups = int(part.max()) + 1
+    stats = AdaptStats()
+    t0 = time.perf_counter()
+    # cap_mult stays at the API default: the prediction-weighted
+    # partition (main) bounds every group's FINAL size by its weight
+    # share, so the standard multiplier already covers the growth and
+    # the group program keeps the proven-compilable shape (a 10x cap
+    # made the per-group program big enough that the tunnel's compile
+    # helper was OOM-killed, and a regrow's fresh compile kills the
+    # worker — see module docstring).
+    mesh2, met2, part_m = grouped_adapt_pass(
+        mesh, met, ngroups, cycles=cycles, part=part, stats=stats,
+        verbose=vb, polish=polish,
+        cap_mult=float(os.environ.get("SCALE_CAPM", "3.0")))
+    adapt_s = time.perf_counter() - t0
+    _save_state(outp, mesh2, met2, part_m, extra={
+        "adapt_s": adapt_s, "cycles_run": stats.cycles,
+        "ops": np.asarray([stats.nsplit, stats.ncollapse, stats.nswap,
+                           stats.nmoved], np.int64),
+        "device": np.asarray(jax.default_backend())})
+
 
 def main():
+    # orchestrator stays off the chip: host staging, displacement and
+    # the final whole-mesh tails are all CPU work.  Setting
+    # JAX_PLATFORMS=cpu is NOT enough on this image — the axon
+    # sitecustomize re-registers the TPU plugin regardless, and a
+    # second tunnel client wedges against the pass workers (the tunnel
+    # is single-client).  Drop the factory explicitly, the same
+    # defensive sequence as tests/conftest.py / __graft_entry__.
+    os.environ["JAX_PLATFORMS"] = "cpu"
     import jax
+    try:
+        from jax._src import xla_bridge as _xb
+        _xb._backend_factories.pop("axon", None)
+    except Exception:
+        pass
+    jax.config.update("jax_platforms", "cpu")
     import jax.numpy as jnp
     jax.config.update("jax_compilation_cache_dir",
                       os.environ["JAX_COMPILATION_CACHE_DIR"])
 
-    from parmmg_tpu.core.mesh import make_mesh
+    from parmmg_tpu.core.mesh import make_mesh, mesh_to_host
     from parmmg_tpu.ops.analysis import analyze_mesh
     from parmmg_tpu.ops.quality import tet_quality
-    from parmmg_tpu.parallel.groups import grouped_adapt_pass, \
-        how_many_groups
-    from parmmg_tpu.parallel.partition import morton_partition
+    from parmmg_tpu.parallel.groups import how_many_groups
+    from parmmg_tpu.parallel.partition import (morton_partition,
+                                               move_interfaces)
     from parmmg_tpu.utils.fixtures import cube_mesh, analytic_iso_metric
-    from parmmg_tpu.ops.adapt import AdaptStats
 
     n = int(os.environ.get("SCALE_N", "56"))
     target = int(os.environ.get("SCALE_TARGET", "24576"))
-    cycles = int(os.environ.get("SCALE_CYCLES", "6"))
+    niter = max(1, int(os.environ.get("SCALE_NITER", "2")))
 
     phases = {}
     t0 = time.perf_counter()
@@ -55,113 +128,149 @@ def main():
     t0 = time.perf_counter()
     # host partition: morton only — fix_contiguity's python BFS is an
     # O(mesh) host stage this datapoint deliberately excludes (group
-    # seams freeze identically either way)
+    # seams freeze identically either way).  The curve is split by
+    # PREDICTED-final-density weights, not initial counts: the shock
+    # slab grows ~6x while coarse regions shrink, so equal-initial
+    # groups overflow their static caps exactly where the work is (the
+    # regrow then forces a fresh remote compile, which is what kills
+    # the tunnel worker — see the module docstring).  A tet of volume
+    # V in a region with target size h ends as ~V/(h^3/(6 sqrt 2))
+    # unit tets; the bisection equilibrium overshoots the ideal count
+    # ~2.2x (measured, bench fixture class).  weight = 1 + predicted
+    # bounds BOTH the initial and the final group size by the group's
+    # weight share, so one static cap fits all groups end to end.
+    h = analytic_iso_metric(vert, "shock", h=1.5 / n)
     cent = vert[tet].mean(axis=1)
-    ngroups = how_many_groups(ntet0, target)
-    part = morton_partition(cent, ngroups)
+    p = vert[tet]
+    vol = np.abs(np.einsum(
+        "ij,ij->i", p[:, 1] - p[:, 0],
+        np.cross(p[:, 2] - p[:, 0], p[:, 3] - p[:, 0]))) / 6.0
+    h_tet = np.asarray(h)[tet].mean(axis=1)
+    pred = 2.2 * vol / (0.1178 * np.maximum(h_tet, 1e-9) ** 3)
+    w = 1.0 + pred
+    ngroups = how_many_groups(int(w.sum()), int(1.5 * target))
+    part = morton_partition(cent, ngroups, weights=w)
     phases["host_partition"] = time.perf_counter() - t0
 
     t0 = time.perf_counter()
-    # stage + analyze the FULL mesh on the CPU backend: the whole-mesh
-    # analysis program at 1M-tet width does not compile through the
-    # tunnel in reasonable time (the round-2 BENCH_N=32 blocker) and
-    # runs once — the groups are what the chip executes
-    cpu = jax.devices("cpu")[0]
-    with jax.default_device(cpu):
-        mesh = make_mesh(vert, tet, capP=2 * len(vert),
-                         capT=2 * len(tet))
-        mesh = analyze_mesh(mesh).mesh
-        h = analytic_iso_metric(vert, "shock", h=1.5 / n)
-        met = jnp.zeros(mesh.capP, mesh.vert.dtype).at[: len(h)].set(
-            jnp.asarray(h, mesh.vert.dtype)).at[len(h):].set(1.0)
-        jax.block_until_ready(mesh.vert)
+    mesh = make_mesh(vert, tet, capP=2 * len(vert), capT=2 * len(tet))
+    mesh = analyze_mesh(mesh).mesh
+    met = jnp.zeros(mesh.capP, mesh.vert.dtype).at[: len(h)].set(
+        jnp.asarray(h, mesh.vert.dtype)).at[len(h):].set(1.0)
+    jax.block_until_ready(mesh.vert)
     phases["stage_analyze"] = time.perf_counter() - t0
 
-    stats = AdaptStats()
-    niter = int(os.environ.get("SCALE_NITER", "2"))
-    vb = 3 if os.environ.get("SCALE_VERBOSE") else 0
+    # ---- grouped passes, one fresh-client subprocess each --------------
+    tmp = os.environ.get("SCALE_TMP", "/tmp/parmmg_scale")
+    os.makedirs(tmp, exist_ok=True)
+    state = f"{tmp}/state0.npz"
     t0 = time.perf_counter()
-    mesh2, met2 = mesh, met
-    part2 = part
-    for it in range(max(1, niter)):
-        # the last pass runs the grouped bad-element polish so the
-        # reported min quality is POST-TAIL (group seams frozen during
-        # a pass are displaced between passes, so the final polish sees
-        # previously-frozen seams as interior)
-        mesh2, met2, part_m = grouped_adapt_pass(
-            mesh2, met2, ngroups, cycles=cycles, part=part2,
-            stats=stats, verbose=vb, polish=(it == max(1, niter) - 1))
-        if it + 1 < max(1, niter):
-            from parmmg_tpu.parallel.partition import move_interfaces
-            from parmmg_tpu.core.mesh import mesh_to_host
-            t1 = time.perf_counter()
+    _save_state(state, mesh, met, part)
+    phases["state_io"] = time.perf_counter() - t0
+    del mesh, met
+
+    cycles_run = 0
+    ops = np.zeros(4, np.int64)
+    dev = "?"
+    for it in range(niter):
+        nxt = f"{tmp}/state{it + 1}.npz"
+        env = dict(os.environ)
+        env.update(SCALE_IN=state, SCALE_OUT=nxt, SCALE_WORKER="1",
+                   SCALE_POLISH="1" if it == niter - 1 else "0")
+        # the worker decides its own backend: default = real chip
+        # (inherit the axon site), SCALE_DEVICE=cpu forces CPU
+        if os.environ.get("SCALE_DEVICE", "") == "cpu":
+            env["JAX_PLATFORMS"] = "cpu"
+        else:
+            env.pop("JAX_PLATFORMS", None)
+        t0 = time.perf_counter()
+        # the pass is idempotent from its input state: on a tunnel
+        # worker crash (the UNAVAILABLE failure mode), retry once in a
+        # fresh process before giving up
+        for attempt in range(2):
+            r = subprocess.run([sys.executable, os.path.abspath(__file__)],
+                               env=env)
+            if r.returncode == 0:
+                break
+            print(f"pass {it} worker attempt {attempt} failed "
+                  f"rc={r.returncode}", file=sys.stderr)
+        if r.returncode != 0:
+            raise RuntimeError(f"pass {it} worker failed rc={r.returncode}")
+        phases[f"pass{it}_total"] = time.perf_counter() - t0
+        z, mesh2, met2, part_m = _load_state(nxt)
+        phases[f"pass{it}_adapt"] = float(z["adapt_s"])
+        cycles_run += int(z["cycles_run"])
+        ops += z["ops"]
+        dev = str(z["device"])
+        state = nxt
+        if it + 1 < niter:
+            t0 = time.perf_counter()
             _, tet_h, _, _, _ = mesh_to_host(mesh2)
-            part2 = move_interfaces(tet_h, part_m, ngroups, nlayers=2)
+            part2 = move_interfaces(tet_h, np.asarray(part_m),
+                                    int(np.asarray(part_m).max()) + 1,
+                                    nlayers=2)
             phases["ifc_displacement"] = \
                 phases.get("ifc_displacement", 0.0) + \
-                (time.perf_counter() - t1)
-    jax.block_until_ready(mesh2.vert)
-    phases["grouped_adapt"] = time.perf_counter() - t0
+                (time.perf_counter() - t0)
+            # rewrite the state with the displaced partition
+            _save_state(state, mesh2, met2, part2)
 
     # post-merge whole-mesh polish on the CPU backend: the grouped
     # polish cannot touch the FINAL seams (frozen in their own pass);
-    # this full-width pass can.  Whole-mesh width does not compile
-    # through the TPU tunnel — the CPU backend is the right home for
-    # this untimed tail (SCALE_MERGED_POLISH=0 skips it).
+    # this full-width pass can (SCALE_MERGED_POLISH=0 skips it).
     from parmmg_tpu.ops.adapt import sliver_polish
     from parmmg_tpu.ops.repair import repair_mesh
     t0 = time.perf_counter()
-    with jax.default_device(cpu):
-        mesh2 = jax.device_put(mesh2, cpu)
-        met2 = jax.device_put(met2, cpu)
-        if os.environ.get("SCALE_MERGED_POLISH", "1") == "1":
-            for w in range(3):
-                mesh2, pc = sliver_polish(
-                    mesh2, met2, jnp.asarray(3000 + w, jnp.int32))
-                pcn = np.asarray(pc)
-                if int(pcn[0]) == 0 and int(pcn[1]) == 0:
-                    break
+    met2 = jnp.asarray(met2)
+    mesh2 = jax.tree.map(jnp.asarray, mesh2)
+    if os.environ.get("SCALE_MERGED_POLISH", "1") == "1":
+        for w in range(3):
+            mesh2, pc = sliver_polish(
+                mesh2, met2, jnp.asarray(3000 + w, jnp.int32))
+            pcn = np.asarray(pc)
+            if int(pcn[0]) == 0 and int(pcn[1]) == 0:
+                break
     phases["merged_polish"] = time.perf_counter() - t0
 
     # sequential tail repair (host, O(bad tets)) — the production
-    # driver's _finish_run role; runs on CPU views
+    # driver's _finish_run role
     t0 = time.perf_counter()
-    with jax.default_device(cpu):
-        mesh2, _nrep = repair_mesh(mesh2, met2)
+    mesh2, _nrep = repair_mesh(mesh2, met2)
     phases["repair_tail"] = time.perf_counter() - t0
 
     t0 = time.perf_counter()
     tm = np.asarray(mesh2.tmask)
-    with jax.default_device(cpu):       # full-width program: CPU compile
-        mesh2c = jax.device_put(mesh2, cpu)
-        q = np.asarray(tet_quality(mesh2c, jax.device_put(met2, cpu)))[tm]
+    q = np.asarray(tet_quality(mesh2, met2))[tm]
     phases["quality_pull"] = time.perf_counter() - t0
 
     # throughput accounting mirrors bench.py: live tets examined per
-    # cycle / adapt wall seconds.  The first-pass number INCLUDES the
-    # one-time compile of the group program (reported separately as the
-    # steady rate can't be isolated without a second pass at this size).
-    examined = stats.cycles * ntet0        # lower bound (mesh only grows)
-    rate = examined / max(phases["grouped_adapt"], 1e-9) / 1e6
+    # cycle / adapt wall seconds.  Worker numbers INCLUDE the one-time
+    # compiles (reported separately in phases_s as passN_adapt vs
+    # passN_total = adapt + state IO + process start).
+    adapt_s = sum(v for k, v in phases.items() if k.endswith("_adapt"))
+    examined = cycles_run * ntet0          # lower bound (mesh only grows)
+    rate = examined / max(adapt_s, 1e-9) / 1e6
     print(json.dumps({
         "metric": "grouped_scale_throughput",
         "value": round(rate, 4),
         "unit": "Mtets/sec/chip (incl. one-time compile)",
         "extra": {
-            "niter": int(os.environ.get("SCALE_NITER", "2")),
+            "niter": niter,
             "ntets_initial": int(ntet0),
             "ntets_final": int(tm.sum()),
             "ngroups": int(ngroups),
-            "cycles": int(stats.cycles),
-            "ops": [stats.nsplit, stats.ncollapse, stats.nswap,
-                    stats.nmoved],
+            "cycles": int(cycles_run),
+            "ops": [int(v) for v in ops],
             "qmin": round(float(q.min()), 4) if tm.any() else 0.0,
             "qmean": round(float(q.mean()), 4) if tm.any() else 0.0,
             "phases_s": {k: round(v, 2) for k, v in phases.items()},
-            "device": str(jax.devices()[0].platform),
+            "device": dev,
         },
     }))
 
 
 if __name__ == "__main__":
-    main()
+    if os.environ.get("SCALE_WORKER") == "1":
+        worker()
+    else:
+        main()
