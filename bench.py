@@ -68,19 +68,18 @@ def _ensure_reachable_backend(probe_timeout_s: int = 240) -> None:
 
 def main() -> None:
     # persistent compile cache: the adapt-cycle graph takes minutes to
-    # compile cold; cached executables make repeated bench runs start fast
-    _cache = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                          ".jax_cache")
-    os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", _cache)
-    os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
+    # compile cold; cached executables make repeated bench runs start
+    # fast.  Shared wiring with the CLI and the scale drivers
+    # (utils/compilecache) — env set AFTER backend selection so the
+    # CPU-fallback path stays uncached (set_cache_env declines on
+    # JAX_PLATFORMS=cpu: the XLA:CPU AOT cache is unreliable on this
+    # image), config pushed after jax import.
+    from parmmg_tpu.utils.compilecache import (enable_persistent_cache,
+                                               ledger_snapshot)
     _ensure_reachable_backend()
     import jax
     import jax.numpy as jnp
-    jax.config.update("jax_compilation_cache_dir",
-                      os.environ["JAX_COMPILATION_CACHE_DIR"])
-    jax.config.update(
-        "jax_persistent_cache_min_compile_time_secs",
-        float(os.environ["JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS"]))
+    enable_persistent_cache()
 
     from parmmg_tpu.core.mesh import make_mesh
     from parmmg_tpu.ops.active import adapt_cycles_auto
@@ -281,7 +280,12 @@ def main() -> None:
                   "aniso": aniso,
                   "device": str(jax.devices()[0].platform),
                   "fallback": os.environ.get(
-                      "PARMMG_BENCH_FALLBACK", "") == "1"},
+                      "PARMMG_BENCH_FALLBACK", "") == "1",
+                  # compile-churn accounting (utils/compilecache): per
+                  # governed entry point {calls, variants, compiles,
+                  # compile_s} — a regression shows up as variants or
+                  # compiles growing with the cycle count
+                  "compile_ledger": ledger_snapshot()},
     }))
 
 

@@ -101,6 +101,18 @@ def main(argv=None) -> int:
     if not args.inp:
         print("missing -in <mesh>", file=sys.stderr)
         return 1
+    # persistent compile cache (compile governor): the adapt programs
+    # take minutes to compile cold and are identical across runs —
+    # default the cache dir (env JAX_COMPILATION_CACHE_DIR wins) so
+    # repeat CLI invocations and subprocess workers start warm.
+    # set_cache_env itself declines on the forced-CPU backend, and the
+    # fallback guard below re-drops the cache when the accelerator is
+    # absent and jax silently resolves to XLA:CPU (whose AOT cache is
+    # unreliable on this image).
+    from .utils.compilecache import (drop_cache_on_cpu_fallback,
+                                     set_cache_env)
+    set_cache_env()
+    drop_cache_on_cpu_fallback()
 
     from .io import medit
     from .io.distributed import probe_distributed, load_distributed_mesh

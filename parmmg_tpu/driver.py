@@ -399,6 +399,15 @@ def _finish_run(pm, mesh, met, stats, info, tim, bg_mesh, bg_fields,
         print_quality_report(mesh, met, info)
     if info.imprim >= C.PMMG_VERB_STEPS:
         print(tim.report())
+        # compile-churn accounting (utils/compilecache): a steady state
+        # whose ledger keeps growing is recompiling, not computing
+        from .utils.timers import format_ledger, ledger_snapshot
+        # registration alone (import-time @governed) leaves all-zero
+        # rows; only print once something was actually called/compiled
+        if any(r["calls"] or r["compiles"]
+               for r in ledger_snapshot().values()):
+            print("  -- COMPILE LEDGER (XLA backend compiles)")
+            print(format_ledger())
     return mesh, met, stats
 
 

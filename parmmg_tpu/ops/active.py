@@ -88,7 +88,16 @@ NARROW_HEADROOM_DIV = 4
 
 
 def narrow_rows(capT: int) -> int:
-    return min(capT, max(NARROW_MIN, capT // _narrow_div()))
+    """Narrow sub-mesh row budget, BUCKETED (compile governor): the raw
+    capT//div drifts with every capacity choice and A keys the compile
+    of every narrow-cycle program — bucketing from the NARROW_MIN floor
+    collapses those onto a handful of variants.  The geo (1.5x) ladder,
+    not pow2: a pow2 round-up can widen the tuned capT//8 narrow width
+    by almost 2x, silently giving back the measured capT/4 -> capT/8
+    throughput win (comment above NARROW_DIV)."""
+    from ..utils.compilecache import bucket
+    return bucket(max(NARROW_MIN, capT // _narrow_div()),
+                  floor=NARROW_MIN, scheme="geo", cap=capT)
 
 
 def dirty_from_diff(pre: Mesh, post: Mesh, pre_met=None, post_met=None):
@@ -360,7 +369,10 @@ def adapt_cycles_auto_impl(mesh: Mesh, met, pending, okflag, wave0,
     return mesh, met, pending, okflag, jnp.stack(counts_all)
 
 
-adapt_cycles_auto = partial(jax.jit, static_argnames=(
-    "swap_flags", "full_flags", "hausd", "do_smooth", "do_insert",
-    "budget_div", "final_rebuild", "window"),
-    donate_argnums=(0, 1, 2))(adapt_cycles_auto_impl)
+from ..utils.compilecache import governed as _governed  # noqa: E402
+
+adapt_cycles_auto = _governed("active.adapt_cycles_auto")(
+    partial(jax.jit, static_argnames=(
+        "swap_flags", "full_flags", "hausd", "do_smooth", "do_insert",
+        "budget_div", "final_rebuild", "window"),
+        donate_argnums=(0, 1, 2))(adapt_cycles_auto_impl))

@@ -63,7 +63,8 @@ def adapt_cycle_impl(mesh: Mesh, met: jax.Array, wave: jax.Array,
                      hausd: float | None = None,
                      budget_div: int = 8,
                      et0=None, vact=None, submesh: bool = False,
-                     wide: bool = False, wwin=None):
+                     wide: bool = False, wwin=None,
+                     prescreen: bool = True):
     """One adaptation cycle: split -> collapse -> [swap] -> [smooth].
 
     Pure jittable function (jitted wrapper below) — also the compile-check
@@ -122,12 +123,13 @@ def adapt_cycle_impl(mesh: Mesh, met: jax.Array, wave: jax.Array,
         if hausd is not None:
             from .analysis import ridge_vertex_tangents
             vtan0 = ridge_vertex_tangents(mesh, et=et0)
-        # wide convergence-verification cycles disable the approximate
+        # wide convergence-verification cycles (and the drivers' polish
+        # cycles, via ``prescreen=False``) disable the approximate
         # nomination prescreen so shells it over-vetoed get one exact
         # re-evaluation before convergence is accepted (split.py)
         res = split_wave(mesh, met, hausd=hausd, budget_div=budget_div,
                          et=et0, lens=lens0, vtan=vtan0, vact=vact,
-                         prescreen=not wide)
+                         prescreen=prescreen and not wide)
         mesh, met = res.mesh, res.met
         nsplit, overflow = res.nsplit, res.overflow
         defer = defer | res.deferred
@@ -191,10 +193,13 @@ def adapt_cycle_impl(mesh: Mesh, met: jax.Array, wave: jax.Array,
     return mesh, met, counts
 
 
-adapt_cycle = partial(jax.jit, static_argnames=(
-    "do_swap", "do_smooth", "smooth_waves", "do_insert", "final_rebuild",
-    "hausd", "budget_div", "submesh", "wide"),
-    donate_argnums=(0, 1))(adapt_cycle_impl)
+from ..utils.compilecache import governed as _governed  # noqa: E402
+
+adapt_cycle = _governed("adapt.cycle")(
+    partial(jax.jit, static_argnames=(
+        "do_swap", "do_smooth", "smooth_waves", "do_insert", "final_rebuild",
+        "hausd", "budget_div", "submesh", "wide", "prescreen"),
+        donate_argnums=(0, 1))(adapt_cycle_impl))
 
 
 def fem_pass_impl(mesh: Mesh, met: jax.Array):
@@ -279,10 +284,11 @@ def adapt_cycles_fused_impl(mesh: Mesh, met: jax.Array, wave0: jax.Array,
     return mesh, met, jnp.stack(counts_all)
 
 
-adapt_cycles_fused = partial(jax.jit, static_argnames=(
-    "n_cycles", "swap_every", "swap_offset", "hausd", "swap_flags",
-    "do_smooth", "do_insert", "budget_div"),
-    donate_argnums=(0, 1))(adapt_cycles_fused_impl)
+adapt_cycles_fused = _governed("adapt.cycles_fused")(
+    partial(jax.jit, static_argnames=(
+        "n_cycles", "swap_every", "swap_offset", "hausd", "swap_flags",
+        "do_smooth", "do_insert", "budget_div"),
+        donate_argnums=(0, 1))(adapt_cycles_fused_impl))
 
 
 def default_cycle_block(x=None) -> int:
@@ -357,9 +363,10 @@ def sliver_polish_impl(mesh: Mesh, met: jax.Array, wave: jax.Array,
     return mesh, counts
 
 
-sliver_polish = partial(jax.jit, static_argnames=(
-    "sliver_q", "do_collapse", "do_swap", "do_smooth", "hausd"),
-    donate_argnums=(0,))(sliver_polish_impl)
+sliver_polish = _governed("adapt.sliver_polish")(
+    partial(jax.jit, static_argnames=(
+        "sliver_q", "do_collapse", "do_swap", "do_smooth", "hausd"),
+        donate_argnums=(0,))(sliver_polish_impl))
 
 
 def grow_mesh_met(mesh: Mesh, met, newP: int, newT: int):

@@ -240,8 +240,10 @@ def edge_lengths(mesh: Mesh, et: EdgeTable, met: jax.Array) -> jax.Array:
         # default may be a TPU plugin while this computation lowers for
         # CPU devices): jnp formula normally, interpreted Pallas kernel
         # when PARMMG_TPU_PALLAS=1 forces kernel numerics everywhere
+        # (jaxcompat shim: 0.4.x lowers every branch — see jaxcompat)
+        from ..utils.jaxcompat import platform_dependent
         off_tpu = partial(pal, interpret=True) if pallas_forced() else ref
-        return jax.lax.platform_dependent(
+        return platform_dependent(
             p0, p1, m0, m1,
             tpu=partial(pal, interpret=False), default=off_tpu)
     return ref(p0, p1, m0, m1)

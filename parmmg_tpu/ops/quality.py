@@ -144,11 +144,12 @@ def tet_quality(mesh: Mesh, met: jax.Array | None = None) -> jax.Array:
         # off-TPU branch chosen at lowering time: jnp formula normally,
         # interpreted Pallas kernel when PARMMG_TPU_PALLAS=1 forces the
         # production kernel numerics everywhere
+        from ..utils.jaxcompat import platform_dependent
         if met is None or met.ndim == 1:
             off_tpu = (partial(quality_pallas, m6bar=None, interpret=True)
                        if pallas_forced()
                        else lambda pp: quality_from_points(pp, None))
-            q = jax.lax.platform_dependent(
+            q = platform_dependent(
                 p,
                 tpu=partial(quality_pallas, m6bar=None, interpret=False),
                 default=off_tpu)
@@ -156,7 +157,7 @@ def tet_quality(mesh: Mesh, met: jax.Array | None = None) -> jax.Array:
             m6bar = jnp.mean(met[mesh.tet], axis=1)
             off_tpu = (partial(quality_pallas, interpret=True)
                        if pallas_forced() else _quality_m6bar)
-            q = jax.lax.platform_dependent(
+            q = platform_dependent(
                 p, m6bar,
                 tpu=partial(quality_pallas, interpret=False),
                 default=off_tpu)

@@ -207,12 +207,16 @@ def split_wave(mesh: Mesh, met: jax.Array, lmax: float = LLONG,
         # stays as the precise guard (incl. hausd-lifted midpoints,
         # where the half-quality bound is only approximate — the bound
         # is NOT exact for the quality measure, so near-floor parents
-        # can be over-vetoed; the wide convergence-verification cycle
-        # passes prescreen=False so blocked shells get re-evaluated by
-        # the exact veto before convergence is accepted).
+        # can be over-vetoed).  The 2x margin (was 4x, ADVICE r3: the
+        # wide margin permanently blocked near-floor shells whose
+        # children pass the exact veto, stalling refinement in
+        # low-quality regions) keeps the starvation guard while halving
+        # the over-veto band; the wide convergence-verification cycle
+        # AND the drivers' polish cycles pass prescreen=False so any
+        # still-blocked shell gets an exact re-evaluation.
         if prescreen:
             q_par = quality_from_points(mesh.vert[mesh.tet])
-            nominate = nominate & (q_par > 4.0 * QUAL_FLOOR)[:, None]
+            nominate = nominate & (q_par > 2.0 * QUAL_FLOOR)[:, None]
         has_nom = jnp.any(nominate, axis=1)
         loc_n = jnp.argmax(nominate, axis=1)              # [capT]
         e_n = jnp.clip(et.edge_id[ar0, loc_n], 0, capE - 1)

@@ -26,6 +26,16 @@ from ..core.mesh import MESH_FIELDS
 
 
 def main(inp: str, outp: str) -> None:
+    # persistent compile cache (compile governor): this fresh-client
+    # process would otherwise recompile the grouped polish program from
+    # scratch every run.  Must be the config-push variant: the
+    # MESH_FIELDS import above already imported jax, which reads
+    # JAX_COMPILATION_CACHE_DIR only once at import time — an env-only
+    # set here would be a silent no-op.  Declines on a CPU backend (the
+    # XLA:CPU AOT cache is unreliable on this image — tests/conftest.py
+    # rationale).
+    from ..utils.compilecache import enable_persistent_cache
+    enable_persistent_cache()
     import jax
     import jax.numpy as jnp
     from ..core.mesh import Mesh

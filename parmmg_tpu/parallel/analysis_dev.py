@@ -319,7 +319,7 @@ def dist_analysis(dmesh, angedg: float, KS: int):
       (vtag [S,capP], etag [S,capT,6], overflow scalar).
     """
     from jax.sharding import PartitionSpec as P
-    from jax import shard_map
+    from ..utils.jaxcompat import shard_map
     from .dist import _unstack
 
     spec = P("shard")

@@ -252,16 +252,31 @@ def pad_comm_tables(node_lists, face_lists, owner,
     order — the A.4 contract) into the device-ready InterfaceComms
     layout.  Single source of truth for the padding/K>=1 clamps, shared
     by build_interface_comms and the migration rebuild
-    (parallel/migrate.py)."""
+    (parallel/migrate.py).
+
+    Pad widths are BUCKETED (utils/compilecache.bucket), not exact: the
+    tables are rebuilt with drifting interface sizes every migration
+    iteration, and every jitted consumer of node_idx/face_idx
+    (dist_interface_check, dist_analysis, flood_labels, graph_probe)
+    keys its compile on these shapes — exact pads meant one fresh XLA
+    compile per iteration in the steady state.  The item axes use the
+    geometric 1.5x scheme (wide tables; pow2 doubling wastes up to 2x
+    the interface memory), the neighbor axis pow2 from a floor of 2.
+    Consumers already tolerate pads by construction (-1 idx, cnt
+    arrays)."""
+    from ..utils.compilecache import bucket
     S = n_shards
     nbrs = [[b for b in range(S)
              if b != s and (node_lists[s][b] or face_lists[s][b])]
             for s in range(S)]
-    K = max(1, max((len(x) for x in nbrs), default=1))
-    In = max(1, max((len(node_lists[s][b]) for s in range(S)
-                     for b in range(S)), default=1))
-    If = max(1, max((len(face_lists[s][b]) for s in range(S)
-                     for b in range(S)), default=1))
+    K = bucket(max((len(x) for x in nbrs), default=1), floor=2,
+               cap=max(1, S - 1))
+    In = bucket(max((len(node_lists[s][b]) for s in range(S)
+                     for b in range(S)), default=1),
+                floor=64, scheme="geo")
+    If = bucket(max((len(face_lists[s][b]) for s in range(S)
+                     for b in range(S)), default=1),
+                floor=64, scheme="geo")
     nbr = np.full((S, K), -1, np.int32)
     node_idx = np.full((S, K, In), -1, np.int32)
     node_cnt = np.zeros((S, K), np.int32)
@@ -315,9 +330,10 @@ def halo_exchange(vals, send_idx, nbr, axis_name: str = "shard",
     """
     import jax
     import jax.numpy as jnp
+    from ..utils.jaxcompat import axis_size
 
     K, I = send_idx.shape
-    S = jax.lax.axis_size(axis_name)
+    S = axis_size(axis_name)
     safe = jnp.clip(send_idx, 0, vals.shape[0] - 1)
     send = jnp.where(
         (send_idx >= 0).reshape(K, I + (vals.ndim - 1) * 0, *([1] *
@@ -359,10 +375,11 @@ def halo_exchange_grouped(vals, send_idx, nbr, G: int,
     Returns recv [G, K, I, ...] (zeros on pads)."""
     import jax
     import jax.numpy as jnp
+    from ..utils.jaxcompat import axis_size
 
     Gk, K, I = send_idx.shape
     assert Gk == G
-    S = jax.lax.axis_size(axis_name)
+    S = axis_size(axis_name)
     P_ = vals.shape[1]
     safe = jnp.clip(send_idx, 0, P_ - 1)                 # [G,K,I]
     g_ar = jnp.arange(G)[:, None, None]

@@ -25,10 +25,12 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh as DeviceMesh, PartitionSpec as P, NamedSharding
-from jax import shard_map
+
+from ..utils.jaxcompat import shard_map
 
 from ..core.mesh import Mesh
 from ..ops.quality import tet_quality, quality_histogram
+from ..utils.compilecache import bucket, governed
 
 
 MAX_SHARD_REGROWS = 6
@@ -114,7 +116,8 @@ def dist_adapt_cycle(dmesh: DeviceMesh, do_swap: bool = True,
 
 def dist_adapt_block(dmesh: DeviceMesh, swap_flags: tuple,
                      do_smooth: bool = True, do_insert: bool = True,
-                     hausd: float | None = None, G: int = 1):
+                     hausd: float | None = None, G: int = 1,
+                     pre_flags: tuple | None = None):
     """SPMD fused cycle block: ``len(swap_flags)`` adapt cycles in ONE
     jitted shard_map program — the production analogue of
     ops.adapt.adapt_cycles_fused.  One dispatch + one psum'd counter
@@ -135,6 +138,8 @@ def dist_adapt_block(dmesh: DeviceMesh, swap_flags: tuple,
     """
     from ..ops.adapt import adapt_cycle_impl
     spec = P("shard")
+    if pre_flags is None:
+        pre_flags = (True,) * len(swap_flags)
 
     def one_shard(mesh: Mesh, met, wave0):
         counts_all = []
@@ -142,7 +147,8 @@ def dist_adapt_block(dmesh: DeviceMesh, swap_flags: tuple,
             mesh, met, counts = adapt_cycle_impl(
                 mesh, met, wave0 + c, do_swap=dosw, do_smooth=do_smooth,
                 do_insert=do_insert, smooth_waves=2, hausd=hausd,
-                final_rebuild=(c == len(swap_flags) - 1))
+                final_rebuild=(c == len(swap_flags) - 1),
+                prescreen=pre_flags[c])
             counts_all.append(counts)
         return mesh, met, jnp.stack(counts_all)            # [n, 8]
 
@@ -165,15 +171,15 @@ def dist_adapt_block(dmesh: DeviceMesh, swap_flags: tuple,
                    in_specs=(spec, spec, P()),
                    out_specs=(spec, spec, P(), P()),
                    check_vma=False)
-    return jax.jit(fn)
+    return governed("dist.adapt_block")(jax.jit(fn))
 
 
 class DistSteps:
     """Per-driver-invocation cache of compiled SPMD block programs keyed
-    by the swap-flag tuple.  jax.jit caches by function identity, so a
-    fresh shard_map per outer iteration would recompile the multi-minute
-    SPMD graph every time; the multi-iteration drivers build ONE of
-    these and reuse it."""
+    by the (swap, prescreen) flag tuples.  jax.jit caches by function
+    identity, so a fresh shard_map per outer iteration would recompile
+    the multi-minute SPMD graph every time; the multi-iteration drivers
+    build ONE of these and reuse it."""
 
     def __init__(self, dmesh: DeviceMesh, do_smooth: bool = True,
                  do_insert: bool = True, hausd: float | None = None,
@@ -183,12 +189,16 @@ class DistSteps:
                        hausd=hausd, G=G)
         self._cache: dict = {}
 
-    def get(self, flags: tuple):
+    def get(self, flags: tuple, pre_flags: tuple | None = None):
         flags = tuple(bool(f) for f in flags)
-        if flags not in self._cache:
-            self._cache[flags] = dist_adapt_block(self.dmesh, flags,
-                                                  **self.kw)
-        return self._cache[flags]
+        if pre_flags is None:
+            pre_flags = (True,) * len(flags)
+        pre_flags = tuple(bool(f) for f in pre_flags)
+        key = (flags, pre_flags)
+        if key not in self._cache:
+            self._cache[key] = dist_adapt_block(
+                self.dmesh, flags, pre_flags=pre_flags, **self.kw)
+        return self._cache[key]
 
 
 def dist_interface_check(dmesh: DeviceMesh, G: int = 1):
@@ -252,13 +262,17 @@ def refresh_shard_analysis_device(stacked: Mesh, comms, n_shards: int,
     if glo_np.max() >= np.iinfo(np.int32).max:
         return None                      # int32 id budget exhausted
     capT = stacked.tet.shape[1]
-    KS = int(min(12 * capT,
-                 max(1024, 4 * comms.node_idx[0].size)))
+    # bucketed shared-record budget (compile governor): the comm tables
+    # drift between migrations and an exact KS would key a fresh
+    # dist_analysis compile each outer iteration
+    KS = bucket(max(1024, 4 * comms.node_idx[0].size),
+                floor=1024, cap=12 * capT)
     key = (angedg, KS, n_shards)
     if cache is not None and key in cache:
         fn = cache[key]
     else:
-        fn = dist_analysis(dmesh, angedg, KS)
+        fn = governed("dist.analysis", budget=2)(
+            dist_analysis(dmesh, angedg, KS))
         if cache is not None:
             cache[key] = fn
     vt, et, ovf = fn(
@@ -383,11 +397,24 @@ def dist_quality(dmesh: DeviceMesh):
     return jax.jit(fn)
 
 
+# compiled interface-echo programs keyed by (device ids, G): the echo
+# runs once per outer iteration and after every migration, and a fresh
+# jax.jit object per call would recompile the shard_map program every
+# time even at identical shapes — the cache plus the bucketed comm-table
+# pads (comms.pad_comm_tables) bound it to a handful of variants
+_IFC_CHECK_CACHE: dict = {}
+
+
 def check_interface_echo(stacked, met_s, comms, dmesh, vert_h, G: int = 1):
     """On-device interface coordinate+metric echo (the production chkcomm
     guard, chkcomm_pmmg.c:815 role); raises on an ordering-contract
     violation."""
-    chk = dist_interface_check(dmesh, G=G)
+    key = (tuple(d.id for d in np.asarray(dmesh.devices).flat), G)
+    chk = _IFC_CHECK_CACHE.get(key)
+    if chk is None:
+        chk = governed("dist.interface_check", budget=2)(
+            dist_interface_check(dmesh, G=G))
+        _IFC_CHECK_CACHE[key] = chk
     diag = float(np.linalg.norm(vert_h.max(0) - vert_h.min(0))) \
         if len(vert_h) else 1.0
     nbad = int(chk(
@@ -430,10 +457,14 @@ def run_adapt_cycles(stacked, met_s, steps: DistSteps, cycles,
     while c < cycles:
         nblk = min(block, cycles - c)
         # swaps every 3rd cycle (see ops.adapt.adapt_mesh) and on the
-        # final two (quality polish before the merge/migration)
+        # final two (quality polish before the merge/migration); those
+        # polish cycles also bypass the approximate split prescreen so
+        # near-floor shells it over-vetoed get one exact re-evaluation
+        # (ops/split.py, ADVICE r3)
         flags = tuple((cc % 3 == 2 or cc >= cycles - 2) and not noswap
                       for cc in range(c, c + nblk))
-        step = steps.get(flags)
+        pres = tuple(cc < cycles - 2 for cc in range(c, c + nblk))
+        step = steps.get(flags, pres)
         stacked, met_s, counts, ovf = step(stacked, met_s,
                                            jnp.asarray(c, jnp.int32))
         ca = np.asarray(counts)                  # [nblk, 4]
@@ -710,7 +741,7 @@ def distributed_adapt_multi(mesh: Mesh, met, n_shards: int,
     shared_prev = None
     if use_band:
         from .migrate_dev import (extend_ids_device, band_migrate_iteration,
-                                  band_weld)
+                                  band_weld, session_ids_fit)
         glo_d = jnp.asarray(np.stack(glo).astype(np.int32))
         # initially-shared gids: interface vertices of the initial comms
         shared_prev = _shared_gids(comms, glo, n_shards)
@@ -738,7 +769,7 @@ def distributed_adapt_multi(mesh: Mesh, met, n_shards: int,
             # iteration could hand out ids past int31, take the host
             # path (which re-derives a compact numbering) instead of
             # silently aliasing device ids
-            ids_fit = top + n_shards * KN < 2 ** 31
+            ids_fit = session_ids_fit(top, n_shards, KN)
             oke = False
             if ids_fit:
                 glo_d2, top_d, f_rows, f_gids, oke = extend_ids_device(

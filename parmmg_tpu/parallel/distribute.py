@@ -90,8 +90,17 @@ def split_to_shards(mesh: Mesh, met, part: np.ndarray, nparts: int,
         maxP = max(maxP, len(gids))
         maxT = max(maxT, len(ltet_g))
 
-    capP = max(64, int(cap_mult * maxP))
-    capT = max(64, int(cap_mult * maxT))
+    # BUCKETED shard capacities (compile governor): every per-shard and
+    # per-group program (adapt blocks, flood, migration, analysis) keys
+    # its compile on (capP, capT), and exact cap_mult*max sizes drift
+    # with every re-split — one fresh multi-minute group-program compile
+    # per grouped pass in the steady state, and a late big compile is
+    # what kills tunneled TPU workers at the >=1M-tet scale.  The
+    # geometric 1.5x ladder bounds the overshoot (<= 1.5x the requested
+    # cap) while collapsing drifting sizes onto O(log n) shapes.
+    from ..utils.compilecache import bucket
+    capP = bucket(int(cap_mult * maxP), floor=64, scheme="geo")
+    capT = bucket(int(cap_mult * maxT), floor=64, scheme="geo")
 
     face_is_ifc = np.zeros(n * 4, bool)
     face_is_ifc[ifc_faces] = True

@@ -74,6 +74,80 @@ def group_chunk(ngroups: int) -> int:
     return 0 if c >= ngroups else c
 
 
+# module-level compiled-block caches (compile governor): the builders
+# below close only over hashable knobs, and jax.jit caches by function
+# IDENTITY — per-pass local builders recompiled the group programs
+# every outer iteration even at identical shapes.  Bounded: a handful
+# of (flags, pres, knobs) combos per session.
+_GROUP_BLOCK_CACHE: dict = {}
+_POLISH_BLOCK_CACHE: dict = {}
+
+
+def _group_block(flags: tuple, pres: tuple, nomove: bool,
+                 noinsert: bool, hausd):
+    """Fused cycle block for the group axis (lax.map body): one
+    dispatch + one counter pull per block per outer step (ops.adapt
+    adapt_cycles_fused analogue).  Cached by knobs so repeat passes
+    reuse the compiled program."""
+    from ..ops.adapt import adapt_cycle_impl
+    from ..utils.compilecache import governed
+    key = (flags, pres, nomove, noinsert, hausd)
+    if key in _GROUP_BLOCK_CACHE:
+        return _GROUP_BLOCK_CACHE[key]
+
+    def body(args):
+        m, k, wave = args
+        counts_all = []
+        for cc, dosw in enumerate(flags):
+            m, k, counts = adapt_cycle_impl(
+                m, k, wave + cc, do_swap=dosw,
+                do_smooth=not nomove, do_insert=not noinsert,
+                hausd=hausd, final_rebuild=(cc == len(flags) - 1),
+                prescreen=pres[cc])
+            counts_all.append(counts)
+        return m, k, jnp.stack(counts_all)       # [n, 6]
+
+    @governed("groups.adapt_block")
+    @jax.jit
+    def run(stacked, met_s, wave):
+        n_map = stacked.vert.shape[0]            # chunk or g_exec
+        waves = jnp.full(n_map, wave, jnp.int32)
+        m, k, counts = jax.lax.map(body, (stacked, met_s, waves))
+        return m, k, counts                      # counts [G, n, 6]
+
+    _GROUP_BLOCK_CACHE[key] = run
+    return run
+
+
+def _group_polish_block(noinsert: bool, noswap: bool, nomove: bool,
+                        hausd):
+    """Grouped sliver-polish block (sliver_polish per group under
+    lax.map), cached by knobs for the same jit-identity reason."""
+    from ..ops.adapt import sliver_polish_impl
+    from ..utils.compilecache import governed
+    key = (noinsert, noswap, nomove, hausd)
+    if key in _POLISH_BLOCK_CACHE:
+        return _POLISH_BLOCK_CACHE[key]
+
+    @governed("groups.polish_block")
+    @jax.jit
+    def polish_block(stacked, met_s, wave):
+        def body(args):
+            m, k, w = args
+            m, cnt = sliver_polish_impl(
+                m, k, w, do_collapse=not noinsert,
+                do_swap=not noswap, do_smooth=not nomove,
+                hausd=hausd)
+            return m, k, cnt
+        n_map = stacked.vert.shape[0]            # chunk or g_exec
+        waves = jnp.full(n_map, wave, jnp.int32)
+        m, k, cnt = jax.lax.map(body, (stacked, met_s, waves))
+        return m, k, cnt
+
+    _POLISH_BLOCK_CACHE[key] = polish_block
+    return polish_block
+
+
 def _pad_groups(tree, g_new: int):
     """Pad a stacked pytree's leading group axis to ``g_new`` with dead
     groups (all-zero arrays: masks False, counts 0 — every wave kernel
@@ -100,7 +174,7 @@ def grouped_adapt_pass(mesh: Mesh, met, ngroups: int, cycles: int = 12,
     path (frozen MG_PARBDY group seams make it correct); the map axis
     serializes groups so HBM holds one group's working set at a time.
     """
-    from ..ops.adapt import adapt_cycle_impl, default_cycle_block
+    from ..ops.adapt import default_cycle_block
     from .partition import morton_partition, fix_contiguity
     from .distribute import split_to_shards, merge_shards, grow_shards
     from ..core.mesh import mesh_to_host
@@ -162,42 +236,19 @@ def grouped_adapt_pass(mesh: Mesh, met, ngroups: int, cycles: int = 12,
             cs.append(np.asarray(cnt))
         return stacked, met_s, np.concatenate(cs)
 
-    def one_block(flags: tuple):
-        # fused cycle block inside the lax.map body: one dispatch + one
-        # counter pull per block per outer step (ops.adapt
-        # adapt_cycles_fused analogue for the group axis)
-        def body(args):
-            m, k, wave = args
-            counts_all = []
-            for cc, dosw in enumerate(flags):
-                m, k, counts = adapt_cycle_impl(
-                    m, k, wave + cc, do_swap=dosw,
-                    do_smooth=not nomove, do_insert=not noinsert,
-                    hausd=hausd, final_rebuild=(cc == len(flags) - 1))
-                counts_all.append(counts)
-            return m, k, jnp.stack(counts_all)       # [n, 6]
-
-        @jax.jit
-        def run(stacked, met_s, wave):
-            n_map = stacked.vert.shape[0]            # chunk or g_exec
-            waves = jnp.full(n_map, wave, jnp.int32)
-            m, k, counts = jax.lax.map(body, (stacked, met_s, waves))
-            return m, k, counts                      # counts [G, n, 6]
-
-        return run
-
-    steps: dict = {}
     block = default_cycle_block(stacked.vert)
     c = 0
     regrows = 0
     while c < cycles:
         nblk = min(block, cycles - c)
+        # final-two polish cycles: swap-inclusive AND exact split veto
+        # (prescreen bypass — ops/split.py, ADVICE r3)
         flags = tuple((cc % 3 == 2 or cc >= cycles - 2) and not noswap
                       for cc in range(c, c + nblk))
-        if flags not in steps:
-            steps[flags] = one_block(flags)
+        pres = tuple(cc < cycles - 2 for cc in range(c, c + nblk))
+        step = _group_block(flags, pres, nomove, noinsert, hausd)
         stacked, met_s, counts = _run_chunked(
-            steps[flags], stacked, met_s, jnp.asarray(c, jnp.int32))
+            step, stacked, met_s, jnp.asarray(c, jnp.int32))
         cs = np.asarray(counts).sum(axis=0)       # [n, 6] over groups
         for i in range(nblk):
             tot = cs[i]
@@ -259,21 +310,8 @@ def grouped_adapt_pass(mesh: Mesh, met, ngroups: int, cycles: int = 12,
         # makes a >=1M-tet run report a REAL post-tail min quality
         # without a whole-mesh-width program (which does not compile
         # through the TPU tunnel at that width).
-        from ..ops.adapt import sliver_polish_impl
-
-        @jax.jit
-        def polish_block(stacked, met_s, wave):
-            def body(args):
-                m, k, w = args
-                m, cnt = sliver_polish_impl(
-                    m, k, w, do_collapse=not noinsert,
-                    do_swap=not noswap, do_smooth=not nomove,
-                    hausd=hausd)
-                return m, k, cnt
-            n_map = stacked.vert.shape[0]            # chunk or g_exec
-            waves = jnp.full(n_map, wave, jnp.int32)
-            m, k, cnt = jax.lax.map(body, (stacked, met_s, waves))
-            return m, k, cnt
+        polish_block = _group_polish_block(noinsert, noswap, nomove,
+                                           hausd)
 
         if chunk and _polish_subproc():
             # fresh-process polish (see _polish_worker module docstring:

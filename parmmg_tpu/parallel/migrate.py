@@ -116,6 +116,10 @@ def _flood_one(tet, tmask, vmask, node_idx, nbr, sizes, me, n_shards: int,
     return label, depth
 
 
+from ..utils.compilecache import governed as _governed  # noqa: E402
+
+
+@_governed("migrate.flood_labels", budget=2)
 @partial(jax.jit, static_argnames=("n_shards", "nlayers"))
 def flood_labels(stacked: Mesh, node_idx, nbr, sizes, n_shards: int,
                  nlayers: int = 2):

@@ -43,6 +43,7 @@ import numpy as np
 from .multihost import pull_host as _pull
 from ..core.mesh import Mesh
 from ..core.constants import IDIR
+from ..utils.compilecache import bucket, governed
 
 _I32MAX = 2147483647
 
@@ -53,6 +54,7 @@ _I32MAX = 2147483647
 # NO donation: on a budget overflow (ok=False) the caller falls back to
 # the full-view path with the ORIGINAL arrays — donating them here would
 # hand back deleted buffers exactly on that path
+@governed("migrate_dev.device_migrate", budget=4)
 @partial(jax.jit, static_argnames=("KB", "KV"))
 def device_migrate(stacked: Mesh, met_s, glo_d, labels, depth,
                    KB: int, KV: int):
@@ -281,6 +283,7 @@ def device_migrate(stacked: Mesh, met_s, glo_d, labels, depth,
 # ---------------------------------------------------------------------------
 # exposed-face probe
 # ---------------------------------------------------------------------------
+@governed("migrate_dev.exposed_face_probe", budget=4)
 @partial(jax.jit, static_argnames=("KF",))
 def exposed_face_probe(stacked: Mesh, glo_d, KF: int):
     """Per-shard exposed faces as global-id triples, device-compacted.
@@ -352,6 +355,7 @@ def _unfreeze_bits_j(tags, is_edge_or_vert: bool):
     return out
 
 
+@governed("migrate_dev.retag_device", budget=2)
 @partial(jax.jit, donate_argnums=(0,))
 def retag_device(stacked: Mesh, glo_d, ifc_slots, ifc_vrows):
     """Reconcile freeze tags with the NEW interface, on device.
@@ -447,6 +451,7 @@ def retag_device(stacked: Mesh, glo_d, ifc_slots, ifc_vrows):
 # ---------------------------------------------------------------------------
 # band-scoped weld region probe
 # ---------------------------------------------------------------------------
+@governed("migrate_dev.band_region_probe", budget=4)
 @partial(jax.jit, static_argnames=("KW", "KWp"))
 def band_region_probe(stacked: Mesh, glo_d, seed_tets, KW: int, KWp: int):
     """Tets/vertices within one ring of the seed tet rows, compacted.
@@ -497,6 +502,7 @@ def band_region_probe(stacked: Mesh, glo_d, seed_tets, KW: int, KWp: int):
     return trow, vrow, tcnt, vcnt, v_open, ok
 
 
+@governed("migrate_dev.extend_ids_device", budget=2)
 @partial(jax.jit, static_argnames=("KN",))
 def extend_ids_device(glo_d, vmask, top, KN: int):
     """Assign fresh global ids to adapt-created vertices on device.
@@ -524,6 +530,27 @@ def extend_ids_device(glo_d, vmask, top, KN: int):
     return (glo2, top + jnp.sum(nf),
             jnp.where(valid, rows, -1).astype(jnp.int32),
             jnp.where(valid, gids, -1), ok)
+
+
+def session_ids_fit(top: int, n_shards: int, KN: int) -> bool:
+    """Whether this iteration's fresh-id block provably fits the int32
+    device numbering (the module-docstring contract): extend_ids_device
+    hands out at most ``n_shards * KN`` ids starting at ``top``, and the
+    monotone session counter must never wrap int32 — on a miss the
+    caller takes the host ``extend_global_ids_from_vmask`` path, whose
+    mirror carries int64 (ADVICE r3: guard, don't assume)."""
+    return int(top) + int(n_shards) * int(KN) < 2 ** 31
+
+
+def has_multiway_face_run(eq: np.ndarray) -> bool:
+    """True when the sorted exposed-face keys contain a run of length
+    > 2 — a global-id triple exposed by 3+ shards (non-manifold parallel
+    face).  ``eq`` is the consecutive-equality mask of the lexsorted
+    keys; two adjacent True entries mean three equal keys.  The
+    consecutive-pair linking in band_migrate_iteration would double-link
+    the middle slot, so the caller must fall back to the full-view path
+    for that iteration (ADVICE r3)."""
+    return eq.size > 1 and bool(np.any(eq[1:] & eq[:-1]))
 
 
 # ---------------------------------------------------------------------------
@@ -592,7 +619,7 @@ def band_migrate_iteration(stacked: Mesh, met_s, glo_d,
     order = np.lexsort((K[:, 2], K[:, 1], K[:, 0]))
     Ks, SLs, SHs = K[order], SL[order], SH[order]
     eq = (Ks[1:] == Ks[:-1]).all(1)
-    if eq.size > 1 and bool(np.any(eq[1:] & eq[:-1])):
+    if has_multiway_face_run(eq):
         # a global-id triple exposed by 3+ shards (non-manifold parallel
         # face): the consecutive-pair linking below would double-link the
         # middle slot — fall back to the full-view path this iteration
@@ -671,16 +698,11 @@ def band_migrate_iteration(stacked: Mesh, met_s, glo_d,
     comms = pad_comm_tables(node_lists, face_lists, owner, S)
 
     # ---- retag on device ------------------------------------------------
-    # bucket the static shapes to the next power of two (floored) so the
-    # jitted retag program is reused across iterations instead of
-    # recompiling for every distinct interface size
-    def _bucket(n: int, floor: int = 256) -> int:
-        b = floor
-        while b < n:
-            b *= 2
-        return b
-    KF2 = _bucket(max(1, max(len(x) for x in ifc_face_slots)))
-    KN = _bucket(max(1, max(len(x) for x in ifc_vert_rows)))
+    # bucket the static shapes (compile governor) so the jitted retag
+    # program is reused across iterations instead of recompiling for
+    # every distinct interface size
+    KF2 = bucket(max(len(x) for x in ifc_face_slots), floor=256)
+    KN = bucket(max(len(x) for x in ifc_vert_rows), floor=256)
     slots_d = np.full((S, KF2), capT * 4, np.int32)
     vrows_d = np.full((S, KN), capP, np.int32)
     for s in range(S):
@@ -842,6 +864,7 @@ def flood_band_counts(stacked: Mesh, labels, n_shards: int):
                          labels, me)
 
 
+@governed("migrate_dev.flood_probe", budget=4)
 @partial(jax.jit, static_argnames=("n_shards", "KB"))
 def flood_probe(stacked: Mesh, labels, depth, n_shards: int, KB: int):
     me = jnp.arange(n_shards, dtype=jnp.int32)
@@ -907,10 +930,7 @@ def repair_flood_labels(stacked: Mesh, labels_d, depth_d, n_shards: int,
     if int(cnts.max()) == 0:
         return labels_d, 0
     capT = stacked.tet.shape[1]
-    KB = 1024
-    while KB < int(cnts.max()):
-        KB *= 2
-    KB = min(KB, capT)
+    KB = bucket(int(cnts.max()), floor=1024, cap=capT)
     # pull_host, not device_get: on a multi-process runtime the probe
     # outputs are 'shard'-sharded global arrays (every process computes
     # the identical host repair from the allgathered tables)
@@ -987,6 +1007,7 @@ def repair_flood_labels(stacked: Mesh, labels_d, depth_d, n_shards: int,
 # sort), and the interface-slot cluster ids are computed ON DEVICE and
 # only O(S*G^2 + interface) tables reach the host.
 
+@governed("migrate_dev.graph_probe", budget=4)
 @partial(jax.jit, static_argnames=("n_shards", "G"))
 def graph_probe(stacked: Mesh, face_idx, n_shards: int, G: int):
     """Per shard: morton cluster id per live tet [S, capT], live count
@@ -1054,17 +1075,18 @@ def graph_repartition_labels_band(stacked: Mesh, comms, n_shards: int,
     gather-only-the-graph role) without the full views pull."""
     from .partition import refine_partition
     S, G = n_shards, clusters_per_shard
-    # bucket the comm-table pad shape to the next power of two: the
-    # tables are rebuilt with exact sizes every rebalance iteration and
-    # an exact-shape jit would recompile graph_probe each time (the
-    # same recompile class the retag KF2/KN bucketing fixes)
+    # bucket the comm-table pad shape (compile governor): the tables are
+    # rebuilt every rebalance iteration and an exact-shape jit would
+    # recompile graph_probe each time (the same recompile class the
+    # retag KF2/KN bucketing fixes).  Same ladders as pad_comm_tables
+    # (geo/64 items, pow2/2 capped neighbors) so tables it built pass
+    # through untouched — bucket() is idempotent on its own ladder —
+    # and graph_probe shares the other consumers' compiled-shape
+    # family; only older callers' exact tables get re-padded here.
     fi = comms.face_idx
-    If = 256
-    while If < fi.shape[2]:
-        If *= 2
-    Kn = 2
-    while Kn < fi.shape[1]:
-        Kn *= 2
+    If = bucket(fi.shape[2], floor=64, scheme="geo")
+    Kn = bucket(fi.shape[1], floor=2,
+                cap=max(fi.shape[1], n_shards - 1))
     if (Kn, If) != fi.shape[1:]:
         fi2 = np.full((fi.shape[0], Kn, If), -1, fi.dtype)
         fi2[:, :fi.shape[1], :fi.shape[2]] = fi

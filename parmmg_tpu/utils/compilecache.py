@@ -1,0 +1,375 @@
+"""Compile governor: shape bucketing + a process-wide compile ledger.
+
+The steady-state loop of this system re-runs the SAME programs every
+migration iteration and every adapt wave (the libparmmg1.c remesh/
+repartition cycle), but jitted entry points whose static shapes track
+exact per-iteration sizes recompile forever: the retag KF2/KN widths,
+the interface comm-table pads, group capacities and narrow-row budgets
+all drift by a few entries between iterations, and each drift is a
+fresh multi-second XLA compile (ADVICE round 3; a late big compile is
+also what kills tunneled TPU workers at the >=1M-tet scale).  A serving
+stack bounds and observes its compile count; this module is that layer:
+
+- :func:`bucket` — the ONE shape-rounding policy every dynamic
+  static-shape site routes through (next-pow2 with a floor, or a
+  geometric 1.5x scheme for wide tables where pow2 doubling wastes
+  memory), so repeat iterations land on a small fixed set of shapes;
+- :func:`governed` — an explicit registry decorator for jitted entry
+  points.  Paired with a ``jax.monitoring`` duration listener on the
+  backend-compile event, it maintains a process-wide **compile
+  ledger**: per entry point, the distinct static-shape variants that
+  actually compiled, the compile count, cumulative compile seconds and
+  the last static shapes — printed by bench.py / scripts/scale_big.py
+  so churn regressions are visible in every BENCH artifact, and
+  enforced by ``scripts/run_tests.sh --ledger`` via per-entry variant
+  budgets;
+- :func:`set_cache_env` / :func:`enable_persistent_cache` — the
+  persistent-cache wiring (JAX_COMPILATION_CACHE_DIR) shared by the
+  CLI, bench and scale drivers so cross-process workers
+  (parallel/_polish_worker.py, fresh-client pass subprocesses) reuse
+  compiled executables instead of starting cold.
+"""
+from __future__ import annotations
+
+import dataclasses
+import functools
+import os
+import threading
+
+# the jax.monitoring event recorded around every XLA backend compile
+# (jax._src.dispatch.BACKEND_COMPILE_EVENT; stable across 0.4.x)
+BACKEND_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+
+
+# ---------------------------------------------------------------------------
+# shape bucketing
+# ---------------------------------------------------------------------------
+def bucket(n: int, floor: int = 256, scheme: str = "pow2",
+           cap: int | None = None) -> int:
+    """Round ``n`` up to a bucketed static size.
+
+    ``scheme="pow2"``: next power-of-two multiple of ``floor`` — the
+    default for index tables and compaction budgets (at most 2x
+    overshoot, very few distinct shapes).
+    ``scheme="geo"``: geometric 1.5x ladder from ``floor`` — for WIDE
+    tables (comm item axes, group capacities) where a pow2 jump can
+    waste a large absolute amount of memory; overshoot <= 1.5x while
+    still collapsing drifting sizes onto O(log n) shapes.
+
+    ``cap`` clamps the result (capacity ceilings like capT); a capped
+    bucket may be smaller than ``n`` — callers that cannot truncate
+    must check, exactly as they would for any static budget.
+    """
+    n = max(int(n), 1)
+    b = max(int(floor), 1)
+    if scheme == "pow2":
+        while b < n:
+            b *= 2
+    elif scheme == "geo":
+        while b < n:
+            b = b * 3 // 2 + 1
+    else:
+        raise ValueError(f"unknown bucket scheme {scheme!r}")
+    if cap is not None:
+        b = min(b, int(cap))
+    return b
+
+
+# ---------------------------------------------------------------------------
+# the compile ledger
+# ---------------------------------------------------------------------------
+@dataclasses.dataclass
+class EntryStats:
+    """Per-entry-point compile accounting (mutated under the ledger lock)."""
+    budget: int | None = None      # max allowed compiled variants (None = untracked)
+    calls: int = 0
+    compiles: int = 0              # backend-compile events attributed here
+    compile_secs: float = 0.0
+    keys_seen: set = dataclasses.field(default_factory=set)
+    keys_compiled: set = dataclasses.field(default_factory=set)
+    last_key: tuple = ()
+
+    @property
+    def variants(self) -> int:
+        """Distinct static-shape keys that triggered >= 1 compile."""
+        return len(self.keys_compiled)
+
+
+class CompileLedger:
+    """Process-wide registry: entry point -> EntryStats.
+
+    Attribution: :meth:`track` pushes the entry name on a thread-local
+    stack; the ``jax.monitoring`` listener credits every backend-compile
+    event to the innermost governed entry on the calling thread (XLA
+    compiles synchronously inside the dispatching call).  Events firing
+    outside any governed scope land in the ``(ungoverned)`` aggregate,
+    so total compile time stays visible even for unregistered programs.
+    """
+
+    UNGOVERNED = "(ungoverned)"
+
+    def __init__(self):
+        self._entries: dict[str, EntryStats] = {}
+        self._lock = threading.Lock()
+        self._tls = threading.local()
+        self._listener_installed = False
+
+    # -- registration / listener -------------------------------------------
+    def register(self, name: str, budget: int | None = None) -> None:
+        with self._lock:
+            e = self._entries.setdefault(name, EntryStats())
+            if budget is not None:
+                e.budget = budget
+        self.install_listener()
+
+    def install_listener(self) -> None:
+        if self._listener_installed:
+            return
+        try:
+            from jax import monitoring
+        except Exception:       # pragma: no cover - jax always present
+            return
+        monitoring.register_event_duration_secs_listener(self._on_event)
+        self._listener_installed = True
+
+    def _on_event(self, event: str, duration: float) -> None:
+        if event != BACKEND_COMPILE_EVENT:
+            return
+        stack = getattr(self._tls, "stack", None)
+        name = stack[-1][0] if stack else self.UNGOVERNED
+        with self._lock:
+            e = self._entries.setdefault(name, EntryStats())
+            e.compiles += 1
+            e.compile_secs += float(duration)
+            if stack:
+                e.keys_compiled.add(stack[-1][1])
+
+    # -- call tracking ------------------------------------------------------
+    def track(self, name: str, key: tuple) -> "_TrackScope":
+        return _TrackScope(self, name, key)
+
+    # -- reporting ----------------------------------------------------------
+    def snapshot(self) -> dict:
+        """{entry: {calls, variants, shapes_seen, compiles, compile_s,
+        last_shapes, budget}} — JSON-serializable."""
+        with self._lock:
+            out = {}
+            for name, e in sorted(self._entries.items()):
+                out[name] = {
+                    "calls": e.calls,
+                    "variants": e.variants,
+                    "shapes_seen": len(e.keys_seen),
+                    "compiles": e.compiles,
+                    "compile_s": round(e.compile_secs, 3),
+                    "last_shapes": repr(e.last_key) if e.last_key else "",
+                    "budget": e.budget,
+                }
+            return out
+
+    def violations(self) -> list[str]:
+        """Entries whose compiled-variant count exceeds their budget."""
+        bad = []
+        with self._lock:
+            for name, e in sorted(self._entries.items()):
+                if e.budget is not None and e.variants > e.budget:
+                    bad.append(f"{name}: {e.variants} compiled variants "
+                               f"> budget {e.budget}")
+        return bad
+
+    def format(self, min_compiles: int = 0) -> str:
+        rows = [f"{'entry point':36s} {'calls':>6s} {'vars':>5s} "
+                f"{'compiles':>8s} {'secs':>8s}"]
+        for name, rec in self.snapshot().items():
+            # hide rows that were only registered (import-time @governed)
+            # but never called or compiled; min_compiles raises the bar
+            if rec["calls"] == 0 and rec["compiles"] < max(min_compiles, 1):
+                continue
+            rows.append(f"{name:36s} {rec['calls']:6d} "
+                        f"{rec['variants']:5d} {rec['compiles']:8d} "
+                        f"{rec['compile_s']:8.2f}")
+        return "\n".join(rows)
+
+    def reset(self) -> None:
+        with self._lock:
+            for e in self._entries.values():
+                e.calls = 0
+                e.compiles = 0
+                e.compile_secs = 0.0
+                e.keys_seen.clear()
+                e.keys_compiled.clear()
+                e.last_key = ()
+
+
+class _TrackScope:
+    """Context manager crediting backend compiles inside the scope to a
+    governed entry (one instance per call — the steady-state loop calls
+    governed entries every iteration, so no per-call class creation)."""
+
+    __slots__ = ("_ledger", "_name", "_key")
+
+    def __init__(self, ledger: CompileLedger, name: str, key: tuple):
+        self._ledger = ledger
+        self._name = name
+        self._key = key
+
+    def __enter__(self):
+        led = self._ledger
+        if not hasattr(led._tls, "stack"):
+            led._tls.stack = []
+        led._tls.stack.append((self._name, self._key))
+        with led._lock:
+            e = led._entries.setdefault(self._name, EntryStats())
+            e.calls += 1
+            e.keys_seen.add(self._key)
+            e.last_key = self._key
+        return self
+
+    def __exit__(self, *exc):
+        self._ledger._tls.stack.pop()
+        return False
+
+
+LEDGER = CompileLedger()
+
+
+def _static_key(args, kwargs) -> tuple:
+    """Hashable static-shape key of a call: array leaves contribute
+    (shape, dtype); hashable non-array leaves contribute their value
+    (jit static args); everything else its type name."""
+    import jax
+    leaves = jax.tree_util.tree_leaves((args, kwargs))
+    parts = []
+    for leaf in leaves:
+        if hasattr(leaf, "shape") and hasattr(leaf, "dtype"):
+            parts.append((tuple(leaf.shape), str(leaf.dtype)))
+        else:
+            try:
+                hash(leaf)
+                parts.append(leaf)
+            except TypeError:
+                parts.append(type(leaf).__name__)
+    return tuple(parts)
+
+
+def governed(name: str, budget: int | None = None, key_fn=None):
+    """Register a (usually jitted) entry point with the compile ledger.
+
+    Every call records its static-shape key; backend compiles occurring
+    inside the call are attributed to ``name``.  ``budget`` declares
+    the allowed number of compiled variants (enforced by
+    ``scripts/run_tests.sh --ledger`` and checkable in tests via
+    :func:`ledger_violations`); ``key_fn(*args, **kwargs)`` overrides
+    the default shapes-and-statics key.
+    """
+    def deco(fn):
+        LEDGER.register(name, budget)
+
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            key = key_fn(*args, **kwargs) if key_fn is not None \
+                else _static_key(args, kwargs)
+            with LEDGER.track(name, key):
+                return fn(*args, **kwargs)
+
+        wrapper.__wrapped__ = fn
+        return wrapper
+    return deco
+
+
+# module-level conveniences (re-exported by utils.timers)
+def ledger_snapshot() -> dict:
+    return LEDGER.snapshot()
+
+
+def format_ledger(min_compiles: int = 0) -> str:
+    return LEDGER.format(min_compiles)
+
+
+def reset_ledger() -> None:
+    LEDGER.reset()
+
+
+def ledger_violations() -> list[str]:
+    return LEDGER.violations()
+
+
+# ---------------------------------------------------------------------------
+# persistent-cache wiring
+# ---------------------------------------------------------------------------
+def default_cache_dir() -> str:
+    """Repo-local cache directory (the same .jax_cache bench.py and
+    scripts/profile_adapt.py historically defaulted to)."""
+    root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    return os.path.join(root, ".jax_cache")
+
+
+def set_cache_env(cache_dir: str | None = None) -> str:
+    """Default the persistent-compile-cache env vars WITHOUT importing
+    jax — safe to call before backend selection, and inherited by
+    subprocess workers (_polish_worker, scale_big pass workers).  An
+    existing JAX_COMPILATION_CACHE_DIR always wins.
+
+    Skipped (returns "") on the forced-CPU backend (JAX_PLATFORMS=cpu):
+    the XLA:CPU AOT cache is unreliable on this image (its serializer
+    intermittently aborts — tests/conftest.py rationale).  An explicit
+    ``cache_dir`` argument or a pre-set JAX_COMPILATION_CACHE_DIR env
+    var opts in regardless."""
+    if ("JAX_COMPILATION_CACHE_DIR" not in os.environ
+            and cache_dir is None
+            and os.environ.get("JAX_PLATFORMS", "") == "cpu"):
+        return ""
+    os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
+                          cache_dir or default_cache_dir())
+    os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
+    return os.environ["JAX_COMPILATION_CACHE_DIR"]
+
+
+def enable_persistent_cache(cache_dir: str | None = None) -> str:
+    """set_cache_env + push the values into an already-imported jax
+    config (covers callers that imported jax before the env was set).
+    No-op (returns "") on a CPU backend — checked against the RESOLVED
+    backend, not just the JAX_PLATFORMS env var.  The cache_dir /
+    pre-set-env-var opt-ins only apply on the PINNED CPU backend
+    (JAX_PLATFORMS=cpu); a silent CPU fallback (accelerator
+    absent/unreachable without the pin) always stays uncached, and any
+    cache dir jax already picked up from an inherited env var is
+    actively cleared — there is no legitimate opt-in story for the
+    degraded path."""
+    import jax
+    if jax.default_backend() == "cpu":
+        pinned = os.environ.get("JAX_PLATFORMS", "") == "cpu"
+        opted_in = (cache_dir is not None
+                    or "JAX_COMPILATION_CACHE_DIR" in os.environ)
+        if not (pinned and opted_in):
+            os.environ.pop("JAX_COMPILATION_CACHE_DIR", None)
+            jax.config.update("jax_compilation_cache_dir", None)
+            return ""
+    path = set_cache_env(cache_dir)
+    if not path:
+        return ""
+    jax.config.update("jax_compilation_cache_dir", path)
+    jax.config.update(
+        "jax_persistent_cache_min_compile_time_secs",
+        float(os.environ["JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS"]))
+    return path
+
+
+def drop_cache_on_cpu_fallback() -> bool:
+    """Post-backend-resolution guard for processes that export the
+    cache env BEFORE jax import (CLI, scale_big pass workers): when the
+    backend silently resolved to XLA:CPU without the explicit
+    JAX_PLATFORMS=cpu pin (accelerator absent/unreachable), drop the
+    persistent cache again — the XLA:CPU AOT cache is unreliable on
+    this image (tests/conftest.py rationale), and the env var is popped
+    too so subprocesses cannot inherit the bad combination.  Returns
+    True when dropped.  Resolving the backend here costs nothing extra:
+    every caller runs jax programs right after."""
+    import jax
+    if (os.environ.get("JAX_PLATFORMS", "") != "cpu"
+            and os.environ.get("JAX_COMPILATION_CACHE_DIR")
+            and jax.default_backend() == "cpu"):
+        os.environ.pop("JAX_COMPILATION_CACHE_DIR", None)
+        jax.config.update("jax_compilation_cache_dir", None)
+        return True
+    return False

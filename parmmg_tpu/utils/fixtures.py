@@ -157,3 +157,27 @@ def cylinder_mesh(n: int = 6, r: float = 0.5):
     vert = np.concatenate([c * scale[:, None] * r, vert[:, 2:]], axis=1)
     tet = _orient_positive(vert, tet)
     return vert, tet.astype(np.int32)
+
+
+def steady_state_migration_scenario(niter: int = 4, cycles: int = 2,
+                                    n_shards: int = 2):
+    """The compile-governor CI scenario, shared by the --ledger budget
+    gate (scripts/ledger_check.py) and the tier-1 regression test
+    (tests/test_compile_ledger.py) so the two gates cannot drift apart:
+    ``niter`` migration iterations over a small cube whose interface
+    sizes drift every iteration — the steady-state loop whose retag /
+    extend-ids / flood / interface-check entry points must stay on a
+    bounded set of compiled variants.  Returns the adapted stacked mesh
+    (callers assert on it and on the ledger)."""
+    import jax.numpy as jnp
+    from ..core.mesh import make_mesh
+    from ..ops.analysis import analyze_mesh
+    from ..parallel import dist
+
+    vert, tet = cube_mesh(2)
+    m = make_mesh(vert, tet, capP=6 * len(vert), capT=6 * len(tet))
+    m = analyze_mesh(m).mesh
+    met = jnp.full(m.capP, 0.4, m.vert.dtype)
+    out, _met, _part = dist.distributed_adapt_multi(
+        m, met, n_shards, niter=niter, cycles=cycles)
+    return out

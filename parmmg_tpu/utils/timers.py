@@ -3,11 +3,20 @@
 The reference tracks per-phase times in ``PMMG_ctim[TIMEMAX]`` slots with
 verbosity-gated prints (parmmg.c:35,91; libparmmg1.c:636-948).  Here a
 small nestable timer registry with the same reporting role.
+
+The compile ledger (utils/compilecache.py) is re-exported here so the
+drivers' reporting layer has ONE import surface for both wall-clock and
+compile accounting: ``Timers.report`` for phases,
+``format_ledger``/``ledger_snapshot`` for XLA compile churn.
 """
 from __future__ import annotations
 
 import time
 from contextlib import contextmanager
+
+from .compilecache import (                                    # noqa: F401
+    LEDGER, format_ledger, ledger_snapshot, ledger_violations,
+    reset_ledger)
 
 
 class Timers:

@@ -1,0 +1,61 @@
+"""Compile-ledger budget gate (scripts/run_tests.sh --ledger).
+
+Runs the steady-state migration scenario (4 outer iterations with
+drifting interface sizes, CPU backend) and FAILS (exit 1) when any
+registered entry point exceeded its compiled-variant budget — the CI
+teeth behind the compile governor (utils/compilecache): a change that
+reintroduces per-iteration recompiles (exact static shapes, a fresh
+jit object per call, an unbucketed budget) trips this gate without
+anyone having to eyeball BENCH artifacts.
+"""
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+os.environ["JAX_PLATFORMS"] = "cpu"
+# the virtual multi-device CPU mesh (same setup as tests/conftest.py):
+# the scenario shards over 2 devices
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+# no persistent cache: a warm cache would hide fresh-variant compiles
+os.environ.pop("JAX_COMPILATION_CACHE_DIR", None)
+
+import jax  # noqa: E402
+
+try:
+    from jax._src import xla_bridge as _xb
+    _xb._backend_factories.pop("axon", None)
+except Exception:
+    pass
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+
+def main() -> int:
+    from parmmg_tpu.utils.compilecache import (format_ledger,
+                                               ledger_violations,
+                                               reset_ledger)
+    from parmmg_tpu.utils.fixtures import steady_state_migration_scenario
+
+    reset_ledger()
+    out = steady_state_migration_scenario(niter=4, cycles=2, n_shards=2)
+    assert int(np.asarray(out.tmask).sum()) > 0
+
+    print(format_ledger())
+    bad = ledger_violations()
+    if bad:
+        print("\nLEDGER BUDGET VIOLATIONS:", file=sys.stderr)
+        for v in bad:
+            print(f"  {v}", file=sys.stderr)
+        return 1
+    print("\nledger OK: all entry points within variant budgets")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -9,6 +9,14 @@
 # executable under ctest (cmake/testing/pmmg_tests.cmake).
 set -u
 cd "$(dirname "$0")/.."
+
+# --ledger: compile-governor budget gate only — run the steady-state
+# migration scenario and fail if any registered entry point exceeded
+# its compiled-variant budget (scripts/ledger_check.py).
+if [ "${1:-}" = "--ledger" ]; then
+    exec env JAX_PLATFORMS=cpu python scripts/ledger_check.py
+fi
+
 fail=0
 for f in tests/test_*.py; do
     echo "=== $f"

@@ -50,6 +50,8 @@ def _run_auto(mesh, met, blocks=5, nper=3):
     return mesh, met, dirty, ok, np.asarray(rows)
 
 
+# slow: multi-minute XLA compile on the tier-1 CPU box (tier-2 covers it)
+@pytest.mark.slow
 def test_auto_engages_narrow_and_stays_conforming():
     mesh, met = _setup()
     vol0 = float(np.asarray(tet_volumes(mesh))[np.asarray(mesh.tmask)]
@@ -64,6 +66,8 @@ def test_auto_engages_narrow_and_stays_conforming():
     assert np.isclose(vols.sum(), vol0, rtol=1e-5)
 
 
+# slow: multi-minute XLA compile on the tier-1 CPU box (tier-2 covers it)
+@pytest.mark.slow
 def test_narrow_leaves_untouched_regions_bit_identical():
     mesh, met = _setup()
     # seed the worklist with full cycles
@@ -95,6 +99,8 @@ def test_narrow_leaves_untouched_regions_bit_identical():
     assert (np.asarray(pre.vtag)[far] == np.asarray(mesh2.vtag)[far]).all()
 
 
+# slow: multi-minute XLA compile on the tier-1 CPU box (tier-2 covers it)
+@pytest.mark.slow
 def test_auto_matches_full_quality():
     mesh, met = _setup(n=4)
     mesh_f, met_f = jax.tree.map(jnp.copy, mesh), jnp.copy(met)
@@ -135,6 +141,8 @@ def test_auto_matches_full_quality():
     assert abs(na - nf) < 0.2 * max(na, nf)
 
 
+# slow: multi-minute XLA compile on the tier-1 CPU box (tier-2 covers it)
+@pytest.mark.slow
 def test_adapt_mesh_auto_converges():
     # the host driver path: auto blocks + quiet/wide-check machinery +
     # polish; must converge to the standard quality gates
@@ -146,6 +154,8 @@ def test_adapt_mesh_auto_converges():
     assert st.nsplit > 0
 
 
+# slow: multi-minute XLA compile on the tier-1 CPU box (tier-2 covers it)
+@pytest.mark.slow
 def test_narrow_discard_on_tight_capacity():
     # a mesh with nearly no free tet slots: the narrow branch must
     # either run full (okflag seeding) or discard cleanly — never

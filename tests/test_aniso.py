@@ -12,6 +12,7 @@ from parmmg_tpu.ops.adapt import adapt_mesh
 from parmmg_tpu.ops.quality import edge_length_ani, iso_to_tensor
 from parmmg_tpu.ops.edges import unique_edges, edge_lengths
 from parmmg_tpu.utils.fixtures import cube_mesh
+import pytest
 
 
 def _cube(n=2, capmul=6):
@@ -31,6 +32,8 @@ def test_edge_length_ani_matches_iso_for_isotropic_tensor():
     assert np.allclose(np.asarray(li), np.asarray(la), rtol=1e-5)
 
 
+# slow: multi-minute XLA compile on the tier-1 CPU box (tier-2 covers it)
+@pytest.mark.slow
 def test_aniso_adapt_directional_refinement():
     m = _cube(2)
     # metric: tight spacing (0.15) along x, loose (0.6) along y/z
@@ -57,6 +60,8 @@ def test_aniso_adapt_directional_refinement():
     assert lens.max() < C.LLONG + 0.2
 
 
+# slow: multi-minute XLA compile on the tier-1 CPU box (tier-2 covers it)
+@pytest.mark.slow
 def test_aniso_api_roundtrip():
     from parmmg_tpu.api import ParMesh, IParam
     vert, tet = cube_mesh(2)

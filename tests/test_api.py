@@ -40,6 +40,8 @@ def test_manual_cube_roundtrip_no_adapt():
     assert np.isclose(vol.sum(), 1.0, rtol=1e-4)
 
 
+# slow: multi-minute XLA compile on the tier-1 CPU box (tier-2 covers it)
+@pytest.mark.slow
 def test_manual_cube_refine():
     pm, vert, tet = _staged_cube(2, niter=1)
     pm.set_met_size(1, len(vert))
@@ -53,6 +55,8 @@ def test_manual_cube_refine():
     assert len(tris) > 0
 
 
+# slow: multi-minute XLA compile on the tier-1 CPU box (tier-2 covers it)
+@pytest.mark.slow
 def test_scalar_met_setters_individual():
     pm, vert, tet = _staged_cube(1, niter=1)
     pm.set_met_size(1, len(vert))
@@ -61,6 +65,8 @@ def test_scalar_met_setters_individual():
     assert pm.run() == C.PMMG_SUCCESS
 
 
+# slow: multi-minute XLA compile on the tier-1 CPU box (tier-2 covers it)
+@pytest.mark.slow
 def test_required_vertex_survives():
     pm, vert, tet = _staged_cube(2, niter=1)
     # mark an interior vertex required: it must survive coarsening
@@ -75,6 +81,8 @@ def test_required_vertex_survives():
     assert d < 1e-6
 
 
+# slow: multi-minute XLA compile on the tier-1 CPU box (tier-2 covers it)
+@pytest.mark.slow
 def test_fields_interpolated():
     pm, vert, tet = _staged_cube(2, niter=1)
     pm.set_met_size(1, len(vert))
@@ -90,6 +98,8 @@ def test_fields_interpolated():
     assert np.allclose(f, v @ coef, atol=5e-3)
 
 
+# slow: multi-minute XLA compile on the tier-1 CPU box (tier-2 covers it)
+@pytest.mark.slow
 def test_user_triangle_refs_preserved():
     vert, tet = cube_mesh(2)
     # user declares the z=0 face triangles with ref 7

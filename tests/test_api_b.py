@@ -22,6 +22,8 @@ def _staged_cube(n=2, **ipar):
     return pm, vert, tet
 
 
+# slow: multi-minute XLA compile on the tier-1 CPU box (tier-2 covers it)
+@pytest.mark.slow
 def test_entity_getters_after_adapt():
     """Single-entity + edge/normal/met getters (PMMG_Get_vertex/
     tetrahedron/triangle/edge/normalAtVertex, API_functions_pmmg.c)."""
@@ -90,6 +92,8 @@ def test_print_communicator(tmp_path):
     assert "node communicators: 1" in txt and "color_out 1" in txt
 
 
+# slow: multi-minute XLA compile on the tier-1 CPU box (tier-2 covers it)
+@pytest.mark.slow
 def test_required_tetrahedron_frozen():
     """set_required_tetrahedron freezes the tet through adaptation
     (PMMG/Mmg required-tet contract) and get_tetrahedron reports it."""
@@ -116,6 +120,8 @@ def test_required_tetrahedron_frozen():
     assert any(pm.get_tetrahedron(i + 1)[5] for i in range(ne))
 
 
+# slow: multi-minute XLA compile on the tier-1 CPU box (tier-2 covers it)
+@pytest.mark.slow
 def test_prism_vertices_frozen_and_remapped():
     pm, vert, tet = _staged_cube(2, niter=1)
     pm.set_mesh_size(np_=len(vert), ne=len(tet), nprism=1)

@@ -20,6 +20,9 @@ from parmmg_tpu.parallel.distribute import split_to_shards
 from parmmg_tpu.parallel.comms import build_interface_comms
 from parmmg_tpu.utils.fixtures import cube_mesh
 
+# slow: multi-minute XLA compile on the tier-1 CPU box (tier-2 covers it)
+pytestmark = pytest.mark.slow
+
 
 def _two_shards(n=2, capmul=4):
     vert, tet = cube_mesh(n)

@@ -47,6 +47,8 @@ def test_cli_noop_run(tmp_path):
     assert len(out.tria) > 0            # boundary written
 
 
+# slow: multi-minute XLA compile on the tier-1 CPU box (tier-2 covers it)
+@pytest.mark.slow
 def test_cli_adapt_with_sol(tmp_path):
     p, vert, tet = _write_cube(tmp_path, with_sol=0.3)
     rc = cli_main(["-in", str(p), "-sol", str(tmp_path / "cube.sol"),
@@ -142,6 +144,8 @@ def test_cli_vtu_output(tmp_path):
     assert (tmp_path / "out.vtu").exists()
 
 
+# slow: multi-minute XLA compile on the tier-1 CPU box (tier-2 covers it)
+@pytest.mark.slow
 def test_cli_bench_json(tmp_path, capsys):
     p, vert, tet = _write_cube(tmp_path, with_sol=0.4)
     rc = cli_main(["-in", str(p), "-sol", str(tmp_path / "cube.sol"),
@@ -248,6 +252,8 @@ def test_vtu_reader_roundtrip(tmp_path):
     assert fields == {}
 
 
+# slow: multi-minute XLA compile on the tier-1 CPU box (tier-2 covers it)
+@pytest.mark.slow
 def test_cli_vtu_input(tmp_path):
     """End-to-end: -in cube.vtu (metric in point data) adapts and writes
     the medit output."""

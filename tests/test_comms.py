@@ -87,7 +87,7 @@ def test_halo_exchange_coordinate_echo():
     import jax
     import jax.numpy as jnp
     from jax.sharding import Mesh as DeviceMesh, PartitionSpec as P
-    from jax import shard_map
+    from parmmg_tpu.utils.jaxcompat import shard_map
 
     vert, tet, part, l2g, g2l = _partitioned(n=2, nparts=4)
     comms = build_interface_comms(tet, part, 4, l2g, g2l)

@@ -32,6 +32,8 @@ def _bdy_radial_dev(m):
     return np.abs(rr - 1.0).max()
 
 
+# slow: multi-minute XLA compile on the tier-1 CPU box (tier-2 covers it)
+@pytest.mark.slow
 def test_sphere_hausd_keeps_surface_on_sphere():
     """With hausd, refined boundary points are lifted onto the Bezier
     surface: the radial deviation stays within a few hausd, and is far
@@ -91,6 +93,8 @@ def _bdy_euler(m):
     return V - len(edges) + len(tris)
 
 
+# slow: multi-minute XLA compile on the tier-1 CPU box (tier-2 covers it)
+@pytest.mark.slow
 def test_torus_adapt_preserves_topology_and_quality():
     vert, tet = torus_mesh(nu=16, nc=4)
     m = make_mesh(vert, tet, capP=5 * len(vert), capT=5 * len(tet))

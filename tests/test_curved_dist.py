@@ -15,6 +15,9 @@ from parmmg_tpu.ops.analysis import analyze_mesh
 from parmmg_tpu.ops.quality import tet_quality
 from parmmg_tpu.utils.fixtures import sphere_mesh, torus_mesh
 
+# slow: multi-minute XLA compile on the tier-1 CPU box (tier-2 covers it)
+pytestmark = pytest.mark.slow
+
 
 def _bdy_euler(m):
     """Euler characteristic of the boundary surface (V - E + F)."""

@@ -10,6 +10,10 @@ import jax.numpy as jnp
 from parmmg_tpu.api.parmesh import ParMesh
 from parmmg_tpu.core import constants as C
 from parmmg_tpu.utils.fixtures import cube_mesh
+import pytest
+
+# slow: multi-minute XLA compile on the tier-1 CPU box (tier-2 covers it)
+pytestmark = pytest.mark.slow
 
 
 def _staged_pm(n_devices):

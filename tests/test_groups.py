@@ -15,6 +15,7 @@ from parmmg_tpu.ops.analysis import analyze_mesh
 from parmmg_tpu.ops.quality import tet_quality
 from parmmg_tpu.parallel.groups import how_many_groups, grouped_adapt
 from parmmg_tpu.utils.fixtures import cube_mesh
+import pytest
 
 
 def test_how_many_groups_clamps():
@@ -24,6 +25,8 @@ def test_how_many_groups_clamps():
     assert how_many_groups(10 ** 9, 10) == C.REMESHER_NGRPS_MAX
 
 
+# slow: multi-minute XLA compile on the tier-1 CPU box (tier-2 covers it)
+@pytest.mark.slow
 def test_grouped_adapt_conforming():
     vert, tet = cube_mesh(3)
     m = make_mesh(vert, tet, capP=4 * len(vert), capT=4 * len(tet))
@@ -41,6 +44,8 @@ def test_grouped_adapt_conforming():
     assert q.min() > 0.02
 
 
+# slow: multi-minute XLA compile on the tier-1 CPU box (tier-2 covers it)
+@pytest.mark.slow
 def test_grouped_chunked_matches_unchunked(monkeypatch):
     """Chunked group dispatch (group_chunk: the tunnel-safe bounded
     dispatch) must produce the same mesh as one lax.map over all
@@ -71,6 +76,8 @@ def test_grouped_chunked_matches_unchunked(monkeypatch):
     assert vr.shape == vc.shape and (vr == vc).all()
 
 
+# slow: multi-minute XLA compile on the tier-1 CPU box (tier-2 covers it)
+@pytest.mark.slow
 def test_mesh_size_engages_groups():
     """Setting IParam.meshSize below the mesh size must route the
     single-device run through the grouped path."""

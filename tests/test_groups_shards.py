@@ -25,6 +25,9 @@ from parmmg_tpu.ops.quality import tet_quality
 from parmmg_tpu.utils.fixtures import cube_mesh, analytic_iso_metric
 from parmmg_tpu.parallel.dist import distributed_adapt_multi
 
+# slow: multi-minute XLA compile on the tier-1 CPU box (tier-2 covers it)
+pytestmark = pytest.mark.slow
+
 
 def _run(n_shards, n_devices, niter=2, n=6):
     vert, tet = cube_mesh(n)

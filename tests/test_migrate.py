@@ -23,6 +23,9 @@ from parmmg_tpu.parallel import dist
 from parmmg_tpu.parallel import distribute
 from parmmg_tpu.utils.fixtures import cube_mesh
 
+# slow: multi-minute XLA compile on the tier-1 CPU box (tier-2 covers it)
+pytestmark = pytest.mark.slow
+
 
 def _setup(n=3, capmul=4):
     vert, tet = cube_mesh(n)

@@ -11,6 +11,10 @@ from parmmg_tpu.core import constants as C
 from parmmg_tpu.core.mesh import tet_volumes
 from parmmg_tpu.core.constants import IDIR
 from parmmg_tpu.utils.fixtures import cube_mesh
+import pytest
+
+# slow: multi-minute XLA compile on the tier-1 CPU box (tier-2 covers it)
+pytestmark = pytest.mark.slow
 
 
 def _peninsula_tris(vert, tet, zplane=0.5, xmax=0.5):

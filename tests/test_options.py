@@ -42,18 +42,24 @@ def test_noinsert_keeps_point_count():
     assert np_out == len(cube_mesh(3)[0])      # no insertion or deletion
 
 
+# slow: multi-minute XLA compile on the tier-1 CPU box (tier-2 covers it)
+@pytest.mark.slow
 def test_noswap_suppresses_swaps():
     pm = _run_ok(_staged(noswap=True, hsiz=0.22))
     assert pm.stats.nswap == 0
     assert pm.stats.nsplit > 0                 # sizing still ran
 
 
+# slow: multi-minute XLA compile on the tier-1 CPU box (tier-2 covers it)
+@pytest.mark.slow
 def test_nomove_suppresses_smoothing():
     pm = _run_ok(_staged(nomove=True, hsiz=0.22))
     assert pm.stats.nmoved == 0
     assert pm.stats.nsplit > 0
 
 
+# slow: multi-minute XLA compile on the tier-1 CPU box (tier-2 covers it)
+@pytest.mark.slow
 def test_nosurf_freezes_boundary_vertices():
     pm = _staged(nosurf=True, hsiz=0.22)
     vert0, _ = cube_mesh(3)
@@ -67,6 +73,8 @@ def test_nosurf_freezes_boundary_vertices():
         assert d < 1e-6, f"boundary vertex {v} moved/removed (d={d})"
 
 
+# slow: multi-minute XLA compile on the tier-1 CPU box (tier-2 covers it)
+@pytest.mark.slow
 def test_optim_without_metric():
     pm = _run_ok(_staged(optim=True))
     assert pm.stats.cycles >= 1

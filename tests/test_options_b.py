@@ -8,6 +8,10 @@ from parmmg_tpu.core import constants as C
 from parmmg_tpu.utils.fixtures import cube_mesh
 
 from test_options import _staged, _run_ok
+import pytest
+
+# slow: multi-minute XLA compile on the tier-1 CPU box (tier-2 covers it)
+pytestmark = pytest.mark.slow
 
 
 def test_noridge_detection_flag():

@@ -11,6 +11,9 @@ from parmmg_tpu.core import constants as C
 from parmmg_tpu.core.mesh import tet_volumes
 from parmmg_tpu.utils.fixtures import cube_mesh
 
+# slow: multi-minute XLA compile on the tier-1 CPU box (tier-2 covers it)
+pytestmark = pytest.mark.slow
+
 
 def _staged(n=3, **info_kw):
     vert, tet = cube_mesh(n)

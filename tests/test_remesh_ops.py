@@ -15,6 +15,7 @@ from parmmg_tpu.ops.adapt import adapt_mesh
 from parmmg_tpu.ops.quality import tet_quality
 from parmmg_tpu.ops.edges import unique_edges, edge_lengths
 from parmmg_tpu.utils.fixtures import cube_mesh
+import pytest
 
 
 def _cube(n=2, capmul=4):
@@ -140,6 +141,8 @@ def test_swap32_reduces_shell():
         assert np.isclose(vols1.sum(), vols0, rtol=1e-5)
 
 
+# slow: multi-minute XLA compile on the tier-1 CPU box (tier-2 covers it)
+@pytest.mark.slow
 def test_adapt_refine_and_coarsen_roundtrip():
     m = _cube(2)
     met = jnp.full(m.capP, 0.2)
@@ -155,6 +158,8 @@ def test_adapt_refine_and_coarsen_roundtrip():
     assert m2.np_counts()[0] < n_ref[0]
 
 
+# slow: multi-minute XLA compile on the tier-1 CPU box (tier-2 covers it)
+@pytest.mark.slow
 def test_adapt_target_lengths():
     m = _cube(2)
     met = jnp.full(m.capP, 0.23)
@@ -168,6 +173,8 @@ def test_adapt_target_lengths():
     assert q.min() > 0.1
 
 
+# slow: multi-minute XLA compile on the tier-1 CPU box (tier-2 covers it)
+@pytest.mark.slow
 def test_sliver_polish_improves_min_quality():
     """The bad-element pass (sliver_polish) must raise the min quality of
     a converged adaptation without breaking validity or volume — the
