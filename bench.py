@@ -268,6 +268,19 @@ def main() -> None:
                  "qmin": round(qmin_a, 4),
                  "qmean": round(qmean_a, 4)}
 
+    # ledger regression check against the previous round's artifact:
+    # any entry point whose compiled-variant count GREW since the last
+    # BENCH_r*.json is flagged in the JSON and on stderr (the bench-side
+    # teeth of the compile governor; scripts/ledger_check.py --diff is
+    # the standalone form of the same comparison)
+    ledger = ledger_snapshot()
+    regressions = _ledger_regressions_vs_previous(ledger)
+    if regressions:
+        print("bench: COMPILE-LEDGER VARIANT REGRESSIONS vs previous "
+              "artifact:", file=sys.stderr)
+        for r in regressions:
+            print(f"bench:   {r}", file=sys.stderr)
+
     print(json.dumps({
         "metric": "adapt_cycle_throughput",
         "value": round(mtets_per_sec, 4),
@@ -285,8 +298,18 @@ def main() -> None:
                   # governed entry point {calls, variants, compiles,
                   # compile_s} — a regression shows up as variants or
                   # compiles growing with the cycle count
-                  "compile_ledger": ledger_snapshot()},
+                  "compile_ledger": ledger,
+                  "ledger_regressions": regressions},
     }))
+
+
+def _ledger_regressions_vs_previous(ledger: dict) -> list[str]:
+    """Compare this run's compile ledger against the NEWEST BENCH_r*.json
+    next to this script (shared logic:
+    utils.compilecache.regressions_vs_latest_artifact)."""
+    from parmmg_tpu.utils.compilecache import regressions_vs_latest_artifact
+    here = os.path.dirname(os.path.abspath(__file__))
+    return regressions_vs_latest_artifact(here, "BENCH_r*.json", ledger)
 
 
 _TRANSPORT_MARKERS = (

@@ -359,6 +359,32 @@ def sequential_repair(vert, tet, tmask, vtag, vmask, tref, ftag, etag,
     return vert, tet, tmask, vmask, tref, ftag, etag, fref, nfixed
 
 
+# repair-tail quality probe: ONE module-level jitted object + ledger
+# registration (compile governor).  The eager quality_from_points call
+# this replaces re-dispatched a dozen kernels per repair_mesh call —
+# the tail runs once per pass in the driver and scale workers, so the
+# probe is a steady-state entry point like the other governed tails.
+# No variant budget: the probe's static shape tracks whatever mesh caps
+# the caller holds (merged meshes regrow), which is caller-driven churn
+# the ledger should SHOW, not gate.
+_QPROBE = []
+
+
+def _quality_probe():
+    if not _QPROBE:
+        import jax
+        from ..utils.compilecache import governed
+        from .quality import quality_from_points
+
+        @governed("repair.quality_probe")
+        @jax.jit
+        def probe(vert, tet):
+            return quality_from_points(vert[tet])
+
+        _QPROBE.append(probe)
+    return _QPROBE[0]
+
+
 def repair_mesh(mesh, met, q_floor: float = 1e-3,
                 allow_collapse: bool = True, allow_swap: bool = True,
                 allow_move: bool = True):
@@ -366,10 +392,9 @@ def repair_mesh(mesh, met, q_floor: float = 1e-3,
     adjacency.  Cheap no-op when nothing is below the floor."""
     import dataclasses
     import jax.numpy as jnp
-    from .quality import quality_from_points
     from .adjacency import build_adjacency, boundary_edge_tags
 
-    q = np.asarray(quality_from_points(mesh.vert[mesh.tet]))
+    q = np.asarray(_quality_probe()(mesh.vert, mesh.tet))
     tm = np.asarray(mesh.tmask)
     if not (tm & (q < q_floor)).any():
         return mesh, 0

@@ -404,3 +404,21 @@ def swapgen_wave(mesh: Mesh, met: jax.Array,
         mesh, tet=tet_o, tmask=tmask_o, tref=tref_o, ftag=ftag_o,
         fref=fref_o, etag=etag_o, nelem=nelem.astype(jnp.int32))
     return SwapGenResult(out, nsw)
+
+
+# eager entry point: ONE module-level jitted object + compile-ledger
+# registration (the ROADMAP governor follow-on for the swapgen/repair
+# tails).  The production hot path calls swapgen_wave inline from the
+# already-jitted sliver_polish_impl and is unaffected; this is the
+# governed front door for callers OUTSIDE an enclosing jit (tests,
+# diagnostics, future eager tails) so they neither retrace the wave
+# op-by-op nor mint a fresh jax.jit object per call
+def _make_swapgen_jit():
+    from functools import partial as _partial
+    from ..utils.compilecache import governed
+    return governed("ops.swapgen_wave", budget=4)(
+        _partial(jax.jit, static_argnames=("budget_div", "lmax"))(
+            swapgen_wave))
+
+
+swapgen_wave_j = _make_swapgen_jit()

@@ -35,10 +35,24 @@ refresh stays on device:
 The [KS] shared-record budget is static; if a shard exceeds it the
 program reports overflow and the caller falls back to the host path for
 that iteration (never silently truncates).
+
+**Groups x shards (G > 1)**: :func:`dist_analysis_grouped` runs the
+same pipeline when each device hosts G logical shards (the reference's
+rank-level x group-level decomposition, grpsplit_pmmg.c:1551-1614).
+The [R]-width sort/segment phases run per group under ``lax.map`` —
+the same HBM discipline as the adapt block: peak working set is ONE
+group's record table, not G of them — while the cross-shard phases ride
+two collectives on interface-sized data: one ``all_gather`` of the
+[G, KS] shared-record packs (logical shard l = device*G + slot) and one
+grouped node-comm halo exchange (:func:`comms.halo_exchange_grouped`,
+or its per-device-pair packed variant when the neighbor table is
+sparse).  The per-group record extraction runs twice (once to pack,
+once in the tail) — cheap gathers, traded for never persisting a
+[G, 12*capT] intermediate across the map.
 """
 from __future__ import annotations
 
-from functools import partial
+from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp
@@ -47,16 +61,11 @@ import numpy as np
 from ..core.mesh import Mesh
 from ..core.constants import (
     IDIR, MG_BDY, MG_CRN, MG_GEO, MG_NOM, MG_PARBDY, MG_REF)
-from ..ops.edges import segmented_or, segmented_max
+from ..ops.edges import segmented_or
 
 CLS = np.uint32(MG_GEO | MG_CRN | MG_REF | MG_NOM)
 _EDGE_PAIRS = ((0, 1), (1, 2), (0, 2))
 _I32MAX = jnp.iinfo(jnp.int32).max
-
-
-def _edge_of_table():
-    from ..ops.swap import _EDGE_OF
-    return jnp.asarray(_EDGE_OF)
 
 
 def _sort2(a, b, valid):
@@ -114,18 +123,27 @@ def _classify_sorted(first, valid_s, nu_s, fref_s, angedg):
     return bits_row, first & valid_s      # (row verdicts, head-row mask)
 
 
-def shard_analysis_body(mesh: Mesh, glo, node_idx, nbr, angedg: float,
-                        KS: int, axis_name: str = "shard"):
-    """Per-shard analysis body (call inside shard_map).
+class _Records(NamedTuple):
+    """Boundary-face edge records of ONE shard at static width
+    R = 12*capT (3 edges x 4 faces per tet)."""
+    la: jax.Array          # [R] local endpoint a
+    lb: jax.Array          # [R] local endpoint b
+    valid: jax.Array       # [R] record is a live plain-boundary face edge
+    nu: jax.Array          # [R, 3] unit face normal
+    frf: jax.Array         # [R] face ref
+    trow: jax.Array        # [R] tet row
+    le: jax.Array          # [R] local edge slot 0..5
+    g_lo: jax.Array        # [R] global endpoint min
+    g_hi: jax.Array        # [R] global endpoint max
+    loc_rec: jax.Array     # [R] purely-local record
+    sh_rec: jax.Array      # [R] potentially-shared record
 
-    Returns (vtag_new [capP], etag_new [capT,6], overflow scalar bool).
-    """
+
+def _extract_records(mesh: Mesh, glo) -> _Records:
+    """Extract the [R] record table (the rank-local half of the
+    reference's analys exchange)."""
     capT, capP = mesh.capT, mesh.capP
-    R = 12 * capT
-    eof = _edge_of_table()
     idir = jnp.asarray(IDIR)
-
-    # ---- extract boundary-face edge records -----------------------------
     glo_i = glo.astype(jnp.int32)
     la_l, lb_l, valid_l, nrm_l, fref_l, trow_l, le_l = \
         [], [], [], [], [], [], []
@@ -161,57 +179,60 @@ def shard_analysis_body(mesh: Mesh, glo, node_idx, nbr, angedg: float,
 
     both_ifc = ((mesh.vtag[jnp.clip(la, 0, capP - 1)] & MG_PARBDY) != 0) \
         & ((mesh.vtag[jnp.clip(lb, 0, capP - 1)] & MG_PARBDY) != 0)
-    loc_rec = valid & ~both_ifc
-    sh_rec = valid & both_ifc
+    return _Records(la, lb, valid, nu, frf, trow, le, g_lo, g_hi,
+                    valid & ~both_ifc, valid & both_ifc)
 
-    # ---- local grouping + verdicts --------------------------------------
-    order, _, _, first = _sort2(g_lo, g_hi, loc_rec)
+
+def _local_bits(rec: _Records, angedg: float):
+    """Local grouping + verdicts for the purely-local records.
+    Returns (bits_rec [R] uint32, head_rec [R] bool)."""
+    R = rec.la.shape[0]
+    order, _, _, first = _sort2(rec.g_lo, rec.g_hi, rec.loc_rec)
     bits_srt, head_srt = _classify_sorted(
-        first, loc_rec[order], nu[order], frf[order], angedg)
+        first, rec.loc_rec[order], rec.nu[order], rec.frf[order], angedg)
     bits_rec = jnp.zeros(R, jnp.uint32).at[order].set(
         bits_srt, unique_indices=True)
     head_rec = jnp.zeros(R, bool).at[order].set(
         head_srt, unique_indices=True)
+    return bits_rec, head_rec
 
-    # ---- shared records: compact, all_gather, global grouping -----------
-    n_sh = jnp.sum(sh_rec.astype(jnp.int32))
+
+def _shared_pack(rec: _Records, KS: int):
+    """Compact the potentially-shared records into the fixed [KS]
+    exchange buffer.  Returns (pack dict, overflow bool)."""
+    R = rec.la.shape[0]
+    n_sh = jnp.sum(rec.sh_rec.astype(jnp.int32))
     ovf = n_sh > KS
-    widx = jnp.nonzero(sh_rec, size=KS, fill_value=R)[0]
+    widx = jnp.nonzero(rec.sh_rec, size=KS, fill_value=R)[0]
     wv = widx < R
     wc = jnp.clip(widx, 0, R - 1)
     pack = {
-        "glo": jnp.where(wv, g_lo[wc], _I32MAX),
-        "ghi": jnp.where(wv, g_hi[wc], _I32MAX),
-        "nu": jnp.where(wv[:, None], nu[wc], 0.0),
-        "fref": jnp.where(wv, frf[wc], 0),
+        "glo": jnp.where(wv, rec.g_lo[wc], _I32MAX),
+        "ghi": jnp.where(wv, rec.g_hi[wc], _I32MAX),
+        "nu": jnp.where(wv[:, None], rec.nu[wc], 0.0),
+        "fref": jnp.where(wv, rec.frf[wc], 0),
         "row": jnp.where(wv, wc, R).astype(jnp.int32),
         "valid": wv,
     }
-    me = jax.lax.axis_index(axis_name)
-    gath = {k: jax.lax.all_gather(v, axis_name) for k, v in pack.items()}
-    S = gath["glo"].shape[0]
-    shard_of = jnp.repeat(jnp.arange(S, dtype=jnp.int32), KS)
-    gl = gath["glo"].reshape(S * KS)
-    gh = gath["ghi"].reshape(S * KS)
-    gn = gath["nu"].reshape(S * KS, 3)
-    gf = gath["fref"].reshape(S * KS)
-    grow = gath["row"].reshape(S * KS)
-    gv = gath["valid"].reshape(S * KS)
-    order_g, _, _, first_g = _sort2(gl, gh, gv)
-    bits_g, head_g = _classify_sorted(
-        first_g, gv[order_g], gn[order_g], gf[order_g], angedg)
-    # back to MY record rows: rows of the gathered run with shard == me
-    mine_g = (shard_of[order_g] == me) & gv[order_g]
-    tgt = jnp.where(mine_g, grow[order_g], R)
-    bits_rec = bits_rec.at[tgt].max(bits_g, mode="drop")
-    head_rec = head_rec.at[tgt].max(head_g & mine_g, mode="drop")
-    ovf = jax.lax.pmax(ovf.astype(jnp.int32), axis_name) > 0
+    return pack, ovf
 
-    # ---- vertex classification ------------------------------------------
-    # +1 per endpoint per special edge, contributed by the globally-first
-    # record's shard, then summed across shards at interface vertices
+
+def _merge_pack_verdicts(bits_rec, head_rec, pack, sh_bits, sh_head):
+    """Scatter the [KS] global-exchange verdicts back onto the record
+    rows (pack['row'] already points at R for pad slots)."""
+    bits_rec = bits_rec.at[pack["row"]].max(sh_bits, mode="drop")
+    head_rec = head_rec.at[pack["row"]].max(sh_head & pack["valid"],
+                                            mode="drop")
+    return bits_rec, head_rec
+
+
+def _vertex_payload(mesh: Mesh, rec: _Records, bits_rec, head_rec):
+    """Per-vertex partials of the int-comm reduction: [capP, 4] float32
+    columns (nsing, has_ref, has_nom, on_bdy)."""
+    capP = mesh.capP
     is_spec_rec = bits_rec != 0
     contrib = head_rec & is_spec_rec
+    la, lb, valid = rec.la, rec.lb, rec.valid
     idx2 = jnp.concatenate([jnp.where(contrib, la, capP),
                             jnp.where(contrib, lb, capP)])
     nsing = jnp.zeros(capP + 1, jnp.int32).at[idx2].add(1, mode="drop")
@@ -230,39 +251,40 @@ def shard_analysis_body(mesh: Mesh, glo, node_idx, nbr, angedg: float,
         jnp.where(contrib & ((bits_rec & MG_NOM) != 0), lb, capP)])].max(
         True, mode="drop")[:capP]
     on_bdy_local = (vbits[:capP] & MG_BDY) != 0
-    # but contrib covers shared specials only at the globally-first
-    # shard: ref/nom presence and counts must be reduced across shards
-    # at interface vertices (the int-comm reduction)
-    from .comms import halo_exchange
-    payload = jnp.stack([
+    return jnp.stack([
         nsing.astype(jnp.float32),
         has_ref.astype(jnp.float32),
         has_nom.astype(jnp.float32),
         on_bdy_local.astype(jnp.float32)], axis=1)       # [capP, 4]
-    recv = halo_exchange(payload, node_idx, nbr, axis_name)  # [K,I,4]
-    K, I = node_idx.shape
-    flat = jnp.where(node_idx >= 0, node_idx, capP).reshape(-1)
-    acc = jnp.zeros((capP + 1, 4), jnp.float32).at[flat].add(
-        recv.reshape(K * I, 4), mode="drop")[:capP]
-    nsing_t = nsing + acc[:, 0].astype(jnp.int32)
-    ref_t = has_ref | (acc[:, 1] > 0)
-    nom_t = has_nom | (acc[:, 2] > 0)
-    bdy_t = on_bdy_local | (acc[:, 3] > 0)
 
+
+def _vtag_from_payload(vtag, vmask, payload, acc):
+    """Final vertex classification from the local payload + the summed
+    neighbor contributions (shape-polymorphic over leading axes)."""
+    nsing_t = payload[..., 0].astype(jnp.int32) + \
+        acc[..., 0].astype(jnp.int32)
+    ref_t = (payload[..., 1] > 0) | (acc[..., 1] > 0)
+    nom_t = (payload[..., 2] > 0) | (acc[..., 2] > 0)
+    bdy_t = (payload[..., 3] > 0) | (acc[..., 3] > 0)
     gtag = jnp.where(bdy_t, jnp.uint32(MG_BDY), 0)
     gtag = gtag | jnp.where(nsing_t == 2, jnp.uint32(MG_GEO), 0)
     gtag = gtag | jnp.where((nsing_t == 1) | (nsing_t > 2),
                             jnp.uint32(MG_CRN), 0)
     gtag = gtag | jnp.where(ref_t, jnp.uint32(MG_REF), 0)
     gtag = gtag | jnp.where(nom_t, jnp.uint32(MG_NOM), 0)
-    vtag_new = (mesh.vtag & ~jnp.uint32(CLS)) | (gtag & CLS) | \
+    vtag_new = (vtag & ~jnp.uint32(CLS)) | (gtag & CLS) | \
         (gtag & MG_BDY)
-    vtag_new = jnp.where(mesh.vmask, vtag_new, mesh.vtag)
+    return jnp.where(vmask, vtag_new, vtag)
 
-    # ---- edge tags -------------------------------------------------------
-    # clear stale classification on plain-boundary slots, write record
-    # verdicts, then OR-join the special bits onto every local slot of
-    # the same (local vertex pair) edge
+
+def _etag_rewrite(mesh: Mesh, rec: _Records, bits_rec):
+    """Edge-tag rewrite: clear stale classification on plain-boundary
+    slots, write record verdicts, then OR-join the special bits onto
+    every local slot of the same (local vertex pair) edge."""
+    capT, capP = mesh.capT, mesh.capP
+    R = rec.la.shape[0]
+    la, lb, valid = rec.la, rec.lb, rec.valid
+    is_spec_rec = bits_rec != 0
     plain = ((mesh.etag & MG_BDY) != 0) & ((mesh.etag & MG_PARBDY) == 0)
     etag_flat = (mesh.etag & ~jnp.where(plain, CLS, jnp.uint32(0))
                  ).reshape(-1)
@@ -271,7 +293,7 @@ def shard_analysis_body(mesh: Mesh, glo, node_idx, nbr, angedg: float,
     # carry IDENTICAL verdict bits (same global segment), so duplicate
     # set()s are deterministic; a scatter-MAX would drop bits instead
     # of uniting them
-    slot_flat = jnp.where(valid, trow * 6 + le, capT * 6)
+    slot_flat = jnp.where(valid, rec.trow * 6 + rec.le, capT * 6)
     slot_c = jnp.clip(slot_flat, 0, capT * 6 - 1)
     merged = etag_flat[slot_c] | jnp.where(valid, bits_rec, 0)
     etag_new = etag_flat.at[slot_flat].set(merged, mode="drop")
@@ -308,7 +330,151 @@ def shard_analysis_body(mesh: Mesh, glo, node_idx, nbr, angedg: float,
     # receiver rows are unique (each tet-edge slot appears once)
     etag_new = etag_new.at[tgt_j].set(merged_j, mode="drop",
                                       unique_indices=True)
-    etag_new = etag_new.reshape(capT, 6)
+    return etag_new.reshape(capT, 6)
+
+
+def shard_analysis_body(mesh: Mesh, glo, node_idx, nbr, angedg: float,
+                        KS: int, axis_name: str = "shard"):
+    """Per-shard analysis body (call inside shard_map), G = 1 layout.
+
+    Returns (vtag_new [capP], etag_new [capT,6], overflow scalar bool).
+    """
+    capP = mesh.capP
+    R = 12 * mesh.capT
+
+    # ---- extract + local grouping + verdicts ----------------------------
+    rec = _extract_records(mesh, glo)
+    bits_rec, head_rec = _local_bits(rec, angedg)
+
+    # ---- shared records: compact, all_gather, global grouping -----------
+    pack, ovf = _shared_pack(rec, KS)
+    me = jax.lax.axis_index(axis_name)
+    gath = {k: jax.lax.all_gather(v, axis_name) for k, v in pack.items()}
+    S = gath["glo"].shape[0]
+    shard_of = jnp.repeat(jnp.arange(S, dtype=jnp.int32), KS)
+    gl = gath["glo"].reshape(S * KS)
+    gh = gath["ghi"].reshape(S * KS)
+    gn = gath["nu"].reshape(S * KS, 3)
+    gf = gath["fref"].reshape(S * KS)
+    grow = gath["row"].reshape(S * KS)
+    gv = gath["valid"].reshape(S * KS)
+    order_g, _, _, first_g = _sort2(gl, gh, gv)
+    bits_g, head_g = _classify_sorted(
+        first_g, gv[order_g], gn[order_g], gf[order_g], angedg)
+    # back to MY record rows: rows of the gathered run with shard == me
+    mine_g = (shard_of[order_g] == me) & gv[order_g]
+    tgt = jnp.where(mine_g, grow[order_g], R)
+    bits_rec = bits_rec.at[tgt].max(bits_g, mode="drop")
+    head_rec = head_rec.at[tgt].max(head_g & mine_g, mode="drop")
+    ovf = jax.lax.pmax(ovf.astype(jnp.int32), axis_name) > 0
+
+    # ---- vertex classification ------------------------------------------
+    # +1 per endpoint per special edge, contributed by the globally-first
+    # record's shard, then summed across shards at interface vertices
+    # (the int-comm reduction)
+    from .comms import halo_exchange
+    payload = _vertex_payload(mesh, rec, bits_rec, head_rec)
+    recv = halo_exchange(payload, node_idx, nbr, axis_name)  # [K,I,4]
+    K, I = node_idx.shape
+    flat = jnp.where(node_idx >= 0, node_idx, capP).reshape(-1)
+    acc = jnp.zeros((capP + 1, 4), jnp.float32).at[flat].add(
+        recv.reshape(K * I, 4), mode="drop")[:capP]
+    vtag_new = _vtag_from_payload(mesh.vtag, mesh.vmask, payload, acc)
+
+    # ---- edge tags -------------------------------------------------------
+    etag_new = _etag_rewrite(mesh, rec, bits_rec)
+    return vtag_new, etag_new, ovf
+
+
+def shard_analysis_body_grouped(mesh_s: Mesh, glo_s, node_idx_s, nbr_s,
+                                angedg: float, KS: int, G: int,
+                                packed_M: int | None = None,
+                                axis_name: str = "shard"):
+    """Grouped analysis body (call inside shard_map): the device hosts
+    ``G`` logical shards on the leading axis (logical shard l = device
+    ``l // G``, slot ``l % G`` — the dist.py grouped layout).
+
+    [R]-width phases run one group at a time under ``lax.map``; the
+    cross-shard exchange gathers the [G, KS] shared-record packs in one
+    collective and routes the vertex int-comm reduction through the
+    grouped halo exchange (dense, or per-device-pair packed when
+    ``packed_M`` is set).
+
+    Returns (vtag_new [G, capP], etag_new [G, capT, 6], overflow bool).
+    """
+    from .comms import halo_exchange_grouped, halo_exchange_grouped_packed
+    capP = mesh_s.vert.shape[1]
+
+    # ---- phase 1 (per group, lax.map): shared-record packs --------------
+    def pack_one(args):
+        mesh_g, glo_g = args
+        pack, ovf = _shared_pack(_extract_records(mesh_g, glo_g), KS)
+        return pack, ovf
+
+    packs, ovf_g = jax.lax.map(pack_one, (mesh_s, glo_s))   # [G, KS, ...]
+    ovf = jnp.any(ovf_g)
+
+    # ---- phase 2: one all_gather + the global grouping ------------------
+    # (the "row" field stays local: grouped verdicts return through the
+    # pack-slot index, so the record-row mapping never rides the wire)
+    me = jax.lax.axis_index(axis_name)
+    gath = {k: jax.lax.all_gather(v, axis_name)
+            for k, v in packs.items() if k != "row"}
+    S = gath["glo"].shape[0]                   # devices on the axis
+    L = S * G                                  # logical shards
+    logical_of = jnp.repeat(jnp.arange(L, dtype=jnp.int32), KS)
+    gl = gath["glo"].reshape(L * KS)
+    gh = gath["ghi"].reshape(L * KS)
+    gn = gath["nu"].reshape(L * KS, 3)
+    gf = gath["fref"].reshape(L * KS)
+    gv = gath["valid"].reshape(L * KS)
+    order_g, _, _, first_g = _sort2(gl, gh, gv)
+    bits_g, head_g = _classify_sorted(
+        first_g, gv[order_g], gn[order_g], gf[order_g], angedg)
+    # verdicts for MY logical shards, back in [G, KS] pack-slot layout
+    lo = logical_of[order_g]
+    mine_g = (lo // G == me) & gv[order_g]
+    # pack slot j = flat % KS, group g = (flat // KS) % G
+    src_flat = order_g                          # original gathered index
+    g_tgt = jnp.where(mine_g, (src_flat // KS) % G, G)
+    j_tgt = jnp.where(mine_g, src_flat % KS, 0)
+    sh_bits = jnp.zeros((G, KS), jnp.uint32).at[g_tgt, j_tgt].max(
+        bits_g, mode="drop")
+    sh_head = jnp.zeros((G, KS), bool).at[g_tgt, j_tgt].max(
+        head_g & mine_g, mode="drop")
+    ovf = jax.lax.pmax(ovf.astype(jnp.int32), axis_name) > 0
+
+    # ---- phase 3 (per group, lax.map): verdict merge + local tail -------
+    def tail_one(args):
+        mesh_g, glo_g, sh_bits_g, sh_head_g = args
+        rec = _extract_records(mesh_g, glo_g)
+        bits_rec, head_rec = _local_bits(rec, angedg)
+        pack, _ = _shared_pack(rec, KS)        # same widx order as phase 1
+        bits_rec, head_rec = _merge_pack_verdicts(
+            bits_rec, head_rec, pack, sh_bits_g, sh_head_g)
+        payload = _vertex_payload(mesh_g, rec, bits_rec, head_rec)
+        etag_new = _etag_rewrite(mesh_g, rec, bits_rec)
+        return etag_new, payload
+
+    etag_new, payload = jax.lax.map(
+        tail_one, (mesh_s, glo_s, sh_bits, sh_head))
+
+    # ---- phase 4: grouped int-comm reduction + vertex classification ---
+    if packed_M is not None:
+        recv = halo_exchange_grouped_packed(
+            payload, node_idx_s, nbr_s, G, packed_M, axis_name)
+    else:
+        recv = halo_exchange_grouped(payload, node_idx_s, nbr_s, G,
+                                     axis_name)               # [G,K,I,4]
+    K, I = node_idx_s.shape[1:]
+    flat = jnp.where(node_idx_s >= 0, node_idx_s, capP)       # [G,K,I]
+
+    def acc_one(fl, rc):
+        return jnp.zeros((capP + 1, 4), jnp.float32).at[
+            fl.reshape(-1)].add(rc.reshape(-1, 4), mode="drop")[:capP]
+
+    acc = jax.vmap(acc_one)(flat, recv)                       # [G,capP,4]
+    vtag_new = _vtag_from_payload(mesh_s.vtag, mesh_s.vmask, payload, acc)
     return vtag_new, etag_new, ovf
 
 
@@ -329,6 +495,32 @@ def dist_analysis(dmesh, angedg: float, KS: int):
         vt, et, ovf = shard_analysis_body(
             mesh, glo_s[0], node_idx_s[0], nbr_s[0], angedg, KS)
         return vt[None], et[None], ovf.astype(jnp.int32)
+
+    fn = shard_map(local, mesh=dmesh,
+                   in_specs=(spec, spec, spec, spec),
+                   out_specs=(spec, spec, P()), check_vma=False)
+    return jax.jit(fn)
+
+
+def dist_analysis_grouped(dmesh, angedg: float, KS: int, G: int,
+                          packed_M: int | None = None):
+    """Grouped (G logical shards per device) SPMD analysis-refresh
+    program: same contract as :func:`dist_analysis` with the stacked
+    leading axis carrying S*G logical shards.
+
+    Returns fn(stacked_mesh, glo_s [S*G,capP] int32, node_idx_s, nbr_s)
+      -> (vtag [S*G,capP], etag [S*G,capT,6], overflow scalar).
+    """
+    from jax.sharding import PartitionSpec as P
+    from ..utils.jaxcompat import shard_map
+
+    spec = P("shard")
+
+    def local(mesh_s, glo_s, node_idx_s, nbr_s):
+        vt, et, ovf = shard_analysis_body_grouped(
+            mesh_s, glo_s, node_idx_s, nbr_s, angedg, KS, G,
+            packed_M=packed_M)
+        return vt, et, ovf.astype(jnp.int32)
 
     fn = shard_map(local, mesh=dmesh,
                    in_specs=(spec, spec, spec, spec),

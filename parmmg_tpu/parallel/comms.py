@@ -406,6 +406,125 @@ def halo_exchange_grouped(vals, send_idx, nbr, G: int,
     return jnp.where(valid, recv, 0)
 
 
+def packed_halo_rows(nbr: np.ndarray, G: int,
+                     occupancy: float | None = None) -> int | None:
+    """Per-device-pair packed row budget for
+    :func:`halo_exchange_grouped_packed`, or None when the dense
+    [S, G, G, I] block should be kept.
+
+    ``nbr``: [S*G, K] LOGICAL neighbor table (host numpy).  The packed
+    layout ships one row per actual (group, neighbor) entry instead of
+    a dense G x G tile per device pair, so it wins exactly when the
+    group-neighbor structure is sparse.  Decision = measured occupancy:
+    take the max over (device, dest device) of the actual entry count;
+    if it exceeds ``occupancy * G^2`` (default 0.75, knob
+    PARMMG_HALO_PACK_OCC) the dense tile is at least as tight and the
+    caller keeps it.  The returned budget is BUCKETED on the geo ladder
+    (compile governor: per-pair counts drift every migration; an exact
+    M would key a fresh compile per iteration).
+    """
+    import os
+    if G <= 1:
+        return None
+    if occupancy is None:
+        occupancy = float(os.environ.get("PARMMG_HALO_PACK_OCC", "0.75"))
+    S_l, K = nbr.shape
+    S = S_l // G
+    counts = np.zeros((S, max(S, 1)), np.int64)
+    for l in range(S_l):
+        for b in nbr[l][nbr[l] >= 0]:
+            counts[l // G, int(b) // G] += 1
+    mx = int(counts.max()) if counts.size else 0
+    if mx == 0 or mx > occupancy * G * G:
+        return None
+    from ..utils.compilecache import bucket
+    M = bucket(mx, floor=2, scheme="geo")
+    # after rounding, the packed layout must still beat the dense tile
+    # (headers ride along; require a strict row win)
+    return M if M < G * G else None
+
+
+def halo_exchange_grouped_packed(vals, send_idx, nbr, G: int, M: int,
+                                 axis_name: str = "shard"):
+    """Packed grouped halo exchange: identical contract to
+    :func:`halo_exchange_grouped` without the G^2 dense slot factor.
+
+    Each device scatters its actual (group, neighbor) rows into a
+    [S, M, I] send block (row budget ``M`` from
+    :func:`packed_halo_rows`), with a parallel [S, M, 2] header block
+    carrying (dest_slot, src_group) so the receiver can unpack without
+    reconstructing the sender's packing order.  ONE ``all_to_all`` per
+    block transposes the device axis; the receiver routes each incoming
+    row to its (group, k) table entry by matching the header against
+    its own LOGICAL ``nbr`` table (pair uniqueness makes the scatter
+    collision-free).  Same-device neighbor pairs ride the self-row of
+    the tiled collective, exactly like the dense path.
+
+    Traffic per device: O(S * M * I) payload + O(S * M) headers versus
+    the dense O(S * G^2 * I) — the wire win the G>1 path needs before
+    it can default at scale.
+
+    vals [G, P, ...]; send_idx [G, K, I]; nbr [G, K] logical ids.
+    Returns recv [G, K, I, ...] (zeros on pads)."""
+    import jax
+    import jax.numpy as jnp
+    from ..utils.jaxcompat import axis_size
+
+    Gk, K, I = send_idx.shape
+    assert Gk == G
+    S = axis_size(axis_name)
+    P_ = vals.shape[1]
+    safe = jnp.clip(send_idx, 0, P_ - 1)                 # [G,K,I]
+    g_ar = jnp.arange(G)[:, None, None]
+    gath = vals[jnp.broadcast_to(g_ar, send_idx.shape), safe]
+    vmask = (send_idx >= 0)
+    if gath.ndim > 3:
+        vmask = vmask.reshape(G, K, I, *([1] * (gath.ndim - 3)))
+    send = jnp.where(vmask, gath, 0)                     # [G,K,I,...]
+    tail = send.shape[3:]
+
+    valid = (nbr >= 0)                                   # [G,K]
+    dd = jnp.where(valid, nbr // G, S).reshape(G * K)    # dest device
+    ds = jnp.where(valid, nbr % G, 0).reshape(G * K)     # dest slot
+    sg = jnp.broadcast_to(jnp.arange(G, dtype=nbr.dtype)[:, None],
+                          (G, K)).reshape(G * K)
+    # pack slot = rank of the entry within its destination device, in
+    # (group, k) flat order — deterministic, pads sort last (dd = S)
+    order = jnp.argsort(dd, stable=True)
+    start = jnp.searchsorted(dd[order], jnp.arange(S, dtype=dd.dtype))
+    pos = jnp.zeros(G * K, jnp.int32).at[order].set(
+        jnp.arange(G * K, dtype=jnp.int32), unique_indices=True)
+    slot = pos - start[jnp.clip(dd, 0, S - 1)]
+    slot = jnp.where(valid.reshape(G * K), slot, M)      # pads dropped
+
+    pay = jnp.zeros((S, M, I) + tail, send.dtype)
+    pay = pay.at[dd, slot].set(
+        send.reshape(G * K, I, *tail), mode="drop")
+    hdr = jnp.full((S, M, 2), -1, nbr.dtype)
+    hdr = hdr.at[dd, slot].set(jnp.stack([ds, sg], axis=-1), mode="drop")
+
+    recv_pay = jax.lax.all_to_all(pay, axis_name, split_axis=0,
+                                  concat_axis=0, tiled=True)
+    recv_hdr = jax.lax.all_to_all(hdr, axis_name, split_axis=0,
+                                  concat_axis=0, tiled=True)
+
+    # unpack: row (sd, m) came from logical shard sd*G + hdr.src_group
+    # and targets my group hdr.dest_slot; its k is the unique entry of
+    # my nbr row carrying that logical id (K is small and bucketed)
+    sd = jnp.arange(S, dtype=nbr.dtype)[:, None]         # [S,1]
+    tgt_g = recv_hdr[..., 0]                             # [S,M]
+    src_l = sd * G + recv_hdr[..., 1]
+    rvalid = tgt_g >= 0
+    tgt_gc = jnp.clip(tgt_g, 0, G - 1)
+    eq = nbr[tgt_gc] == src_l[..., None]                 # [S,M,K]
+    hask = jnp.any(eq, axis=-1) & rvalid
+    kk = jnp.argmax(eq, axis=-1).astype(jnp.int32)       # [S,M]
+    out = jnp.zeros((G, K, I) + tail, send.dtype)
+    out = out.at[jnp.where(hask, tgt_gc, G),
+                 jnp.where(hask, kk, 0)].set(recv_pay, mode="drop")
+    return out
+
+
 def merge_owner_max(vals, send_idx, recv):
     """Merge received neighbor values into local entity values with the
     max rule (the reference's max-rank/max-value priority merges)."""

@@ -201,7 +201,8 @@ class DistSteps:
         return self._cache[key]
 
 
-def dist_interface_check(dmesh: DeviceMesh, G: int = 1):
+def dist_interface_check(dmesh: DeviceMesh, G: int = 1,
+                         packed_M: int | None = None):
     """On-device interface echo (PMMG_check_extNodeComm on the jittable
     exchange): every shard sends its interface vertices' coordinates +
     metric through :func:`halo_exchange` and compares against the mirror
@@ -211,12 +212,16 @@ def dist_interface_check(dmesh: DeviceMesh, G: int = 1):
 
     ``G`` > 1: groups x shards composition — the stacked leading axis is
     S*G logical shards and the exchange routes (dest_device, dest_slot)
-    through :func:`comms.halo_exchange_grouped`.
+    through :func:`comms.halo_exchange_grouped`, or the per-device-pair
+    packed layout (:func:`comms.halo_exchange_grouped_packed`) when
+    ``packed_M`` is set (the measured-occupancy decision of
+    :func:`comms.packed_halo_rows`).
 
     Returns fn(stacked_mesh, stacked_met, node_idx[S,K,I], nbr[S,K],
     tol) -> global mismatch count.
     """
-    from .comms import halo_exchange, halo_exchange_grouped
+    from .comms import (halo_exchange, halo_exchange_grouped,
+                        halo_exchange_grouped_packed)
     spec = P("shard")
 
     def local(mesh_s: Mesh, met_s, node_idx_s, nbr_s, tol):
@@ -227,6 +232,9 @@ def dist_interface_check(dmesh: DeviceMesh, G: int = 1):
         if G == 1:
             recv = halo_exchange(vals_g[0], node_idx_s[0],
                                  nbr_s[0])[None]          # [1,K,I,3+m]
+        elif packed_M is not None:
+            recv = halo_exchange_grouped_packed(
+                vals_g, node_idx_s, nbr_s, G, packed_M)
         else:
             recv = halo_exchange_grouped(vals_g, node_idx_s, nbr_s, G)
         capP = mesh_s.vert.shape[1]
@@ -251,28 +259,42 @@ def refresh_shard_analysis_device(stacked: Mesh, comms, n_shards: int,
     sort/segment reductions of the host path run jitted under shard_map,
     keyed by the persistent global numbering — no O(mesh) host pull.
 
+    ``n_shards`` > device count dispatches the GROUPED program
+    (analysis_dev.dist_analysis_grouped): G = n_shards // n_devices
+    logical shards per device, per-group lax.map reductions + the
+    grouped (packed when sparse) halo exchange — the G>1 loop pays the
+    same zero-host-pull bill as G=1.
+
     Returns the updated stacked mesh, or None when the shared-record
     budget overflowed (caller falls back to the host path) — never a
     silent truncation."""
     import os
     if os.environ.get("PARMMG_HOST_ANALYSIS", "") == "1":
         return None
-    from .analysis_dev import dist_analysis
+    from .analysis_dev import dist_analysis, dist_analysis_grouped
+    from .comms import packed_halo_rows
     glo_np = np.stack([np.asarray(g) for g in glo])
     if glo_np.max() >= np.iinfo(np.int32).max:
         return None                      # int32 id budget exhausted
     capT = stacked.tet.shape[1]
+    n_dev = int(np.asarray(dmesh.devices).size)
+    G = max(1, n_shards // max(n_dev, 1))
     # bucketed shared-record budget (compile governor): the comm tables
     # drift between migrations and an exact KS would key a fresh
     # dist_analysis compile each outer iteration
     KS = bucket(max(1024, 4 * comms.node_idx[0].size),
                 floor=1024, cap=12 * capT)
-    key = (angedg, KS, n_shards)
+    Mp = packed_halo_rows(comms.nbr, G) if G > 1 else None
+    key = (angedg, KS, n_shards, G, Mp)
     if cache is not None and key in cache:
         fn = cache[key]
     else:
-        fn = governed("dist.analysis", budget=2)(
-            dist_analysis(dmesh, angedg, KS))
+        if G > 1:
+            fn = governed("dist.analysis_grouped", budget=2)(
+                dist_analysis_grouped(dmesh, angedg, KS, G, packed_M=Mp))
+        else:
+            fn = governed("dist.analysis", budget=2)(
+                dist_analysis(dmesh, angedg, KS))
         if cache is not None:
             cache[key] = fn
     vt, et, ovf = fn(
@@ -374,10 +396,23 @@ def refresh_shard_analysis(stacked: Mesh, comms, n_shards: int,
         etag=jnp.asarray(np.stack(new_etag)))
 
 
+# compiled quality-histogram programs keyed by device ids (compile
+# governor, same rationale as _IFC_CHECK_CACHE below): dist_quality used
+# to hand back a FRESH jax.jit object per call, so periodic quality
+# reports recompiled the shard_map reduction every time — the last
+# per-call jit builder the ROADMAP governor item names
+_QUALITY_CACHE: dict = {}
+
+
 def dist_quality(dmesh: DeviceMesh):
     """Global quality histogram across shards (PMMG_qualhisto analogue,
-    quality_pmmg.c:156 — the custom MPI_Op reduction becomes psum/pmin)."""
+    quality_pmmg.c:156 — the custom MPI_Op reduction becomes psum/pmin).
+    Cached per device mesh + registered in the compile ledger."""
     spec = P("shard")
+    key = tuple(d.id for d in np.asarray(dmesh.devices).flat)
+    cached = _QUALITY_CACHE.get(key)
+    if cached is not None:
+        return cached
 
     def local(mesh_s: Mesh, met_s):
         mesh = _unstack(mesh_s)
@@ -394,7 +429,9 @@ def dist_quality(dmesh: DeviceMesh):
 
     fn = shard_map(local, mesh=dmesh, in_specs=(spec, spec),
                    out_specs=(P(), P(), P(), P(), P()), check_vma=False)
-    return jax.jit(fn)
+    fn = governed("dist.quality", budget=2)(jax.jit(fn))
+    _QUALITY_CACHE[key] = fn
+    return fn
 
 
 # compiled interface-echo programs keyed by (device ids, G): the echo
@@ -408,12 +445,16 @@ _IFC_CHECK_CACHE: dict = {}
 def check_interface_echo(stacked, met_s, comms, dmesh, vert_h, G: int = 1):
     """On-device interface coordinate+metric echo (the production chkcomm
     guard, chkcomm_pmmg.c:815 role); raises on an ordering-contract
-    violation."""
-    key = (tuple(d.id for d in np.asarray(dmesh.devices).flat), G)
+    violation.  G > 1 routes the exchange through the packed grouped
+    layout when the measured occupancy says it beats the dense tile
+    (comms.packed_halo_rows)."""
+    from .comms import packed_halo_rows
+    Mp = packed_halo_rows(comms.nbr, G) if G > 1 else None
+    key = (tuple(d.id for d in np.asarray(dmesh.devices).flat), G, Mp)
     chk = _IFC_CHECK_CACHE.get(key)
     if chk is None:
         chk = governed("dist.interface_check", budget=2)(
-            dist_interface_check(dmesh, G=G))
+            dist_interface_check(dmesh, G=G, packed_M=Mp))
         _IFC_CHECK_CACHE[key] = chk
     diag = float(np.linalg.norm(vert_h.max(0) - vert_h.min(0))) \
         if len(vert_h) else 1.0
@@ -617,7 +658,9 @@ def distributed_adapt_multi(mesh: Mesh, met, n_shards: int,
     x group-level two-level decomposition (grpsplit_pmmg.c:1551-1614).
     The band-migration and flood programs already operate on the logical
     leading axis (plain jit over sharded arrays) and compose unchanged;
-    the analysis refresh takes the host path for G > 1.
+    the analysis refresh dispatches the grouped device program
+    (analysis_dev.dist_analysis_grouped) for G > 1, host path on
+    KS-budget overflow only.
 
     ``mode``: between-iteration label source — "ifc" = advancing-front
     interface displacement (device flood, the default repartitioning of
@@ -800,10 +843,11 @@ def distributed_adapt_multi(mesh: Mesh, met, n_shards: int,
         else:
             vmask_h = _pull(stacked.vmask)
             top = extend_global_ids_from_vmask(glo, vmask_h, top)
-        # device analysis refresh is per-device shard_map (G=1 layout);
-        # grouped runs take the host path (correct, host-width) until
-        # the grouped analysis program lands
-        st2 = None if G > 1 else refresh_shard_analysis_device(
+        # device analysis refresh: per-device shard_map for G=1, the
+        # grouped lax.map program for G>1 (analysis_dev) — the host
+        # path below is the KS-budget-overflow fallback ONLY, so the
+        # steady-state G>1 loop performs zero O(mesh) host pulls
+        st2 = refresh_shard_analysis_device(
             stacked, comms, n_shards, ang, glo, dmesh, cache=ana_cache)
         views = None
         if st2 is not None:

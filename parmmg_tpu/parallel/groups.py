@@ -19,6 +19,14 @@ displaced between outer iterations with the same advancing-front
 machinery, so previously-frozen group seams get remeshed later — the
 two-level loop of the reference.
 
+The MULTI-device composition of the same idea (G logical shards per
+device, ``dist.distributed_adapt_multi(n_devices=...)``) shares this
+module's lax.map HBM discipline and additionally keeps the
+between-iteration refresh on device: grouped analysis
+(analysis_dev.dist_analysis_grouped) + the grouped/packed halo exchange
+(comms.halo_exchange_grouped[_packed]), all governed under the same
+compile-ledger budgets as the blocks below.
+
 ``-metis-ratio`` note: the reference multiplies the group count by
 ``metis_ratio`` for the REDISTRIBUTION split, whose many small groups are
 the METIS graph nodes (grpsplit_pmmg.c:1595-1614).  This framework
@@ -107,7 +115,10 @@ def _group_block(flags: tuple, pres: tuple, nomove: bool,
             counts_all.append(counts)
         return m, k, jnp.stack(counts_all)       # [n, 6]
 
-    @governed("groups.adapt_block")
+    # variant budget: the cycle scheduler emits a handful of (flags,
+    # pres) combos per session and the chunked dispatch pads every
+    # chunk to ONE shape family — growth past this is recompile churn
+    @governed("groups.adapt_block", budget=6)
     @jax.jit
     def run(stacked, met_s, wave):
         n_map = stacked.vert.shape[0]            # chunk or g_exec
@@ -129,7 +140,7 @@ def _group_polish_block(noinsert: bool, noswap: bool, nomove: bool,
     if key in _POLISH_BLOCK_CACHE:
         return _POLISH_BLOCK_CACHE[key]
 
-    @governed("groups.polish_block")
+    @governed("groups.polish_block", budget=4)
     @jax.jit
     def polish_block(stacked, met_s, wave):
         def body(args):

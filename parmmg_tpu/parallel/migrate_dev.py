@@ -846,9 +846,12 @@ def _flood_probe_one(tet, tmask, adja, label, depth, me, KB: int,
             out_touch)
 
 
+@governed("migrate_dev.flood_band_counts", budget=4)
 @partial(jax.jit, static_argnames=("n_shards",))
 def flood_band_counts(stacked: Mesh, labels, n_shards: int):
-    """[S] int32: band size (moving + retained 1-ring) per shard."""
+    """[S] int32: band size (moving + retained 1-ring) per shard.
+    Ledger-registered: runs every rebalance iteration (G=1 AND the
+    grouped layout share the logical-leading-axis program family)."""
     me = jnp.arange(n_shards, dtype=jnp.int32)
 
     def one(tet, tm, adja, lab, m):
@@ -875,6 +878,7 @@ def flood_probe(stacked: Mesh, labels, depth, n_shards: int, KB: int):
     )(stacked.tet, stacked.tmask, stacked.adja, labels, depth, me)
 
 
+@governed("migrate_dev.apply_label_fixes", budget=4)
 @jax.jit
 def _apply_label_fixes(labels, rows, newlab):
     def one(lab, r, nl):

@@ -73,6 +73,28 @@ def is_multiprocess() -> bool:
     return jax.process_count() > 1
 
 
+# cached resharding identities keyed by the target sharding (compile
+# governor): the non-addressable branch below used to build a FRESH
+# ``jax.jit(lambda a: a)`` per call — one recompile per leaf per upload
+# on multi-process runs (the io.distributed writers and every band-table
+# pull route through here).  One cached object per (devices, spec) pair
+# + ledger registration, the check_interface_echo caching pattern.
+_RESHARD_CACHE: dict = {}
+
+
+def _reshard_identity(sh):
+    key = (tuple(d.id for d in np.asarray(sh.mesh.devices).flat),
+           str(sh.spec))
+    fn = _RESHARD_CACHE.get(key)
+    if fn is None:
+        import jax
+        from ..utils.compilecache import governed
+        fn = governed("multihost.reshard", budget=4)(
+            jax.jit(lambda a: a, out_shardings=sh))
+        _RESHARD_CACHE[key] = fn
+    return fn
+
+
 def shard_stacked_global(stacked_host, dmesh):
     """Place a [D, ...]-stacked HOST pytree onto a (possibly multi-host)
     device mesh: each process uploads only the shard slices that live on
@@ -96,9 +118,9 @@ def shard_stacked_global(stacked_host, dmesh):
         if isinstance(x, jax.Array) and not x.is_fully_addressable:
             # already a multi-process global array (e.g. the output of
             # grow_shards' pad on a sharded input): np.asarray would
-            # raise on non-addressable shards — reshard with a jitted
-            # identity instead (XLA inserts the collectives)
-            return jax.jit(lambda a: a, out_shardings=sh)(x)
+            # raise on non-addressable shards — reshard with the cached
+            # jitted identity instead (XLA inserts the collectives)
+            return _reshard_identity(sh)(x)
         x = np.asarray(x)
         if x.shape[0] % len(devs):
             raise ValueError(

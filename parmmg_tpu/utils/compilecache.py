@@ -276,6 +276,88 @@ def governed(name: str, budget: int | None = None, key_fn=None):
     return deco
 
 
+def ledger_diff(old: dict, new: dict) -> list[str]:
+    """Compile-ledger regression check between two snapshots: entry
+    points present in BOTH whose compiled-variant count grew.
+
+    ``old``/``new`` accept either a flat snapshot ({entry: {variants,
+    ...}}) or the nested per-worker shape scale_big emits ({"pass0":
+    {entry: ...}, "host": ...}) — nested levels are flattened with a
+    "<worker>/" prefix and compared per worker.  Entries only in
+    ``new`` are NOT regressions (fresh programs carry their own
+    budgets); a grown variant count on a shared entry is the churn
+    signature bench.py and scripts/scale_big.py flag against the
+    previous BENCH/SCALE artifact."""
+    def flatten(d: dict, prefix: str = "") -> dict:
+        out = {}
+        for k, v in (d or {}).items():
+            if isinstance(v, dict) and "variants" not in v:
+                out.update(flatten(v, prefix + str(k) + "/"))
+            elif isinstance(v, dict):
+                out[prefix + str(k)] = v
+        return out
+
+    fo, fn_ = flatten(old), flatten(new)
+    bad = []
+    for name in sorted(set(fo) & set(fn_)):
+        vo = int(fo[name].get("variants", 0))
+        vn = int(fn_[name].get("variants", 0))
+        if vn > vo:
+            bad.append(f"{name}: {vo} -> {vn} compiled variants")
+    return bad
+
+
+def extract_artifact_ledger(doc) -> dict:
+    """Pull the compile-ledger dict out of any artifact shape we emit:
+    a plain snapshot, bench JSON ({extra: {compile_ledger}}), or the
+    round wrapper ({parsed: {extra: {compile_ledger}}})."""
+    if not isinstance(doc, dict):
+        return {}
+    for path in (("parsed", "extra", "compile_ledger"),
+                 ("extra", "compile_ledger"),
+                 ("compile_ledger",)):
+        d = doc
+        for k in path:
+            d = d.get(k) if isinstance(d, dict) else None
+            if d is None:
+                break
+        if isinstance(d, dict):
+            return d
+    return doc
+
+
+def regressions_vs_latest_artifact(root: str, pattern: str,
+                                   ledger: dict) -> list[str]:
+    """Diff ``ledger`` against the NEWEST round artifact matching
+    ``pattern`` (e.g. "BENCH_r*.json") under ``root`` — the shared
+    bench-side regression check of bench.py / scripts/scale_big.py.
+    Artifacts without a ledger compare clean (the first governed round
+    seeds the baseline)."""
+    import glob
+    import json
+    import re
+
+    def rnum(p: str) -> int:
+        m = re.search(r"r(\d+)\.json$", p)
+        return int(m.group(1)) if m else -1
+
+    def has_rows(d: dict) -> bool:
+        return any(isinstance(v, dict) and
+                   ("variants" in v or has_rows(v)) for v in d.values())
+
+    for path in sorted(glob.glob(os.path.join(root, pattern)),
+                       key=rnum, reverse=True):
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+        except Exception:
+            continue
+        prev = extract_artifact_ledger(doc)
+        if prev and has_rows(prev):
+            return ledger_diff(prev, ledger)
+    return []
+
+
 # module-level conveniences (re-exported by utils.timers)
 def ledger_snapshot() -> dict:
     return LEDGER.snapshot()

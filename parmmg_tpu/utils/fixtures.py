@@ -160,15 +160,23 @@ def cylinder_mesh(n: int = 6, r: float = 0.5):
 
 
 def steady_state_migration_scenario(niter: int = 4, cycles: int = 2,
-                                    n_shards: int = 2):
+                                    n_shards: int = 2,
+                                    n_devices: int | None = None,
+                                    return_all: bool = False):
     """The compile-governor CI scenario, shared by the --ledger budget
     gate (scripts/ledger_check.py) and the tier-1 regression test
     (tests/test_compile_ledger.py) so the two gates cannot drift apart:
     ``niter`` migration iterations over a small cube whose interface
     sizes drift every iteration — the steady-state loop whose retag /
     extend-ids / flood / interface-check entry points must stay on a
-    bounded set of compiled variants.  Returns the adapted stacked mesh
-    (callers assert on it and on the ledger)."""
+    bounded set of compiled variants.  ``n_devices`` < ``n_shards``
+    runs the grouped (G>1) composition, exercising the grouped
+    analysis/halo entry points on the same bucketed shapes.
+
+    Returns the adapted merged mesh, or (mesh, met, part) with
+    ``return_all`` — the shared fixture the burned-down migration tests
+    assert conformity/labels on, so tier-1 pays ONE compile for the
+    whole scenario family instead of one per test."""
     import jax.numpy as jnp
     from ..core.mesh import make_mesh
     from ..ops.analysis import analyze_mesh
@@ -178,6 +186,7 @@ def steady_state_migration_scenario(niter: int = 4, cycles: int = 2,
     m = make_mesh(vert, tet, capP=6 * len(vert), capT=6 * len(tet))
     m = analyze_mesh(m).mesh
     met = jnp.full(m.capP, 0.4, m.vert.dtype)
-    out, _met, _part = dist.distributed_adapt_multi(
-        m, met, n_shards, niter=niter, cycles=cycles)
-    return out
+    out, met_m, part = dist.distributed_adapt_multi(
+        m, met, n_shards, niter=niter, cycles=cycles,
+        n_devices=n_devices)
+    return (out, met_m, part) if return_all else out

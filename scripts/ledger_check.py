@@ -1,19 +1,55 @@
 """Compile-ledger budget gate (scripts/run_tests.sh --ledger).
 
 Runs the steady-state migration scenario (4 outer iterations with
-drifting interface sizes, CPU backend) and FAILS (exit 1) when any
+drifting interface sizes, CPU backend) — once at G=1 and once on the
+grouped G=2 (groups x shards) layout — and FAILS (exit 1) when any
 registered entry point exceeded its compiled-variant budget — the CI
 teeth behind the compile governor (utils/compilecache): a change that
 reintroduces per-iteration recompiles (exact static shapes, a fresh
 jit object per call, an unbucketed budget) trips this gate without
 anyone having to eyeball BENCH artifacts.
+
+``--diff old.json new.json`` instead compares two ledger artifacts
+(plain snapshots, bench JSON with extra.compile_ledger, or the BENCH_r*
+wrapper with parsed.extra.compile_ledger) and exits 1 when any shared
+entry point's compiled-variant count GREW — the bench-side regression
+check bench.py / scripts/scale_big.py run against the previous round's
+artifact.
 """
 from __future__ import annotations
 
+import json
 import os
 import sys
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def diff_main(old_path: str, new_path: str) -> int:
+    from parmmg_tpu.utils.compilecache import (extract_artifact_ledger,
+                                               ledger_diff)
+    with open(old_path) as f:
+        old = extract_artifact_ledger(json.load(f))
+    with open(new_path) as f:
+        new = extract_artifact_ledger(json.load(f))
+    bad = ledger_diff(old, new)
+    if bad:
+        print("LEDGER VARIANT REGRESSIONS:", file=sys.stderr)
+        for v in bad:
+            print(f"  {v}", file=sys.stderr)
+        return 1
+    print(f"ledger diff OK: no entry point grew its variant count "
+          f"({old_path} -> {new_path})")
+    return 0
+
+
+if len(sys.argv) >= 2 and sys.argv[1] == "--diff":
+    if len(sys.argv) != 4:
+        print("usage: ledger_check.py --diff OLD.json NEW.json",
+              file=sys.stderr)
+        sys.exit(2)
+    sys.exit(diff_main(sys.argv[2], sys.argv[3]))
+
 os.environ["JAX_PLATFORMS"] = "cpu"
 # the virtual multi-device CPU mesh (same setup as tests/conftest.py):
 # the scenario shards over 2 devices
@@ -38,23 +74,41 @@ import numpy as np  # noqa: E402
 
 def main() -> int:
     from parmmg_tpu.utils.compilecache import (format_ledger,
+                                               ledger_snapshot,
                                                ledger_violations,
                                                reset_ledger)
     from parmmg_tpu.utils.fixtures import steady_state_migration_scenario
 
-    reset_ledger()
-    out = steady_state_migration_scenario(niter=4, cycles=2, n_shards=2)
-    assert int(np.asarray(out.tmask).sum()) > 0
-
-    print(format_ledger())
-    bad = ledger_violations()
-    if bad:
-        print("\nLEDGER BUDGET VIOLATIONS:", file=sys.stderr)
-        for v in bad:
-            print(f"  {v}", file=sys.stderr)
-        return 1
-    print("\nledger OK: all entry points within variant budgets")
-    return 0
+    rc = 0
+    # budgets are PER steady-state family: one compiled-shape family per
+    # (fixture caps, G) — the ledger is reset between the two scenario
+    # runs so the G=1 and grouped gates stay individually tight instead
+    # of sharing a doubled allowance
+    for label, kwargs, must_call in (
+            ("G=1", dict(niter=4, cycles=2, n_shards=2),
+             ("migrate_dev.device_migrate", "dist.interface_check")),
+            ("G=2 grouped", dict(niter=3, cycles=2, n_shards=4,
+                                 n_devices=2),
+             ("dist.analysis_grouped", "dist.interface_check"))):
+        reset_ledger()
+        out = steady_state_migration_scenario(**kwargs)
+        assert int(np.asarray(out.tmask).sum()) > 0
+        led = ledger_snapshot()
+        for entry in must_call:
+            assert led.get(entry, {}).get("calls", 0) >= 1, \
+                f"{label} scenario no longer exercises {entry}"
+        print(f"--- {label} steady-state scenario")
+        print(format_ledger())
+        bad = ledger_violations()
+        if bad:
+            print(f"\nLEDGER BUDGET VIOLATIONS ({label}):",
+                  file=sys.stderr)
+            for v in bad:
+                print(f"  {v}", file=sys.stderr)
+            rc = 1
+    if rc == 0:
+        print("\nledger OK: all entry points within variant budgets")
+    return rc
 
 
 if __name__ == "__main__":

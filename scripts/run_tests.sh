@@ -11,8 +11,11 @@ set -u
 cd "$(dirname "$0")/.."
 
 # --ledger: compile-governor budget gate only — run the steady-state
-# migration scenario and fail if any registered entry point exceeded
-# its compiled-variant budget (scripts/ledger_check.py).
+# migration scenario (G=1 AND the grouped G=2 layout, so the grouped
+# analysis/exchange entry points are budget-asserted too) and fail if
+# any registered entry point exceeded its compiled-variant budget
+# (scripts/ledger_check.py; its --diff mode compares two BENCH/SCALE
+# artifacts for variant-count regressions).
 if [ "${1:-}" = "--ledger" ]; then
     exec env JAX_PLATFORMS=cpu python scripts/ledger_check.py
 fi

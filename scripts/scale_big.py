@@ -271,6 +271,18 @@ def main():
     adapt_s = sum(v for k, v in phases.items() if k.endswith("_adapt"))
     examined = cycles_run * ntet0          # lower bound (mesh only grows)
     rate = examined / max(adapt_s, 1e-9) / 1e6
+    # bench-side ledger regression check (compile governor teeth): any
+    # entry point whose compiled-variant count grew since the newest
+    # SCALE_r*.json artifact is flagged in the JSON and on stderr
+    # (scripts/ledger_check.py --diff is the standalone comparison)
+    ledger = {**ledgers, "host": ledger_snapshot()}
+    regressions = _ledger_regressions_vs_previous(ledger)
+    if regressions:
+        print("scale: COMPILE-LEDGER VARIANT REGRESSIONS vs previous "
+              "artifact:", file=sys.stderr)
+        for r in regressions:
+            print(f"scale:   {r}", file=sys.stderr)
+
     print(json.dumps({
         "metric": "grouped_scale_throughput",
         "value": round(rate, 4),
@@ -289,9 +301,19 @@ def main():
             # per-pass worker compile ledgers + the orchestrator's own
             # (compile governor): steady-state passes should show ~zero
             # fresh compiles once the persistent cache is warm
-            "compile_ledger": {**ledgers, "host": ledger_snapshot()},
+            "compile_ledger": ledger,
+            "ledger_regressions": regressions,
         },
     }))
+
+
+def _ledger_regressions_vs_previous(ledger: dict) -> list[str]:
+    """Diff this run's (nested per-worker) ledger against the newest
+    SCALE_r*.json in the repo root (shared logic:
+    utils.compilecache.regressions_vs_latest_artifact)."""
+    from parmmg_tpu.utils.compilecache import regressions_vs_latest_artifact
+    root = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..")
+    return regressions_vs_latest_artifact(root, "SCALE_r*.json", ledger)
 
 
 if __name__ == "__main__":

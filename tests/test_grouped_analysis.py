@@ -1,0 +1,257 @@
+"""Grouped (G>1) device analysis + compacted grouped halo exchange.
+
+The groups x shards path (parallel/dist.py G, grpsplit_pmmg.c:1551 role)
+must pay the same zero-host-pull bill as G=1: the grouped analysis
+program (analysis_dev.dist_analysis_grouped) must match the host
+refresh bit-for-bit, and the per-device-pair packed exchange
+(comms.halo_exchange_grouped_packed) must match the dense [S,G,G,I]
+block — including same-device neighbor pairs and pad rows — while
+shipping strictly fewer bytes per all_to_all.
+
+Tier split: the packed-layout policy/parity tests are tier-1 (small
+programs); the full grouped-analysis parity and the G=2 driver run
+carry the usual multi-minute CPU compile and ride the slow tier
+(scripts/run_tests.sh), like the rest of the dist matrix.
+"""
+import dataclasses
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from parmmg_tpu.core.mesh import make_mesh, mesh_to_host
+from parmmg_tpu.core import constants as C
+from parmmg_tpu.ops.analysis import analyze_mesh
+from parmmg_tpu.parallel.analysis_par import extend_numbering
+from parmmg_tpu.parallel.comms import (
+    build_interface_comms, halo_exchange_grouped,
+    halo_exchange_grouped_packed, packed_halo_rows)
+from parmmg_tpu.parallel.dist import (
+    make_device_mesh, refresh_shard_analysis,
+    refresh_shard_analysis_device, shard_stacked)
+from parmmg_tpu.parallel.distribute import split_to_shards
+from parmmg_tpu.parallel.partition import morton_partition, fix_contiguity
+from parmmg_tpu.utils.fixtures import cube_mesh
+
+
+# ---------------------------------------------------------------------------
+# packed-layout policy + wire size (tier-1: host-side numpy only)
+# ---------------------------------------------------------------------------
+def test_packed_rows_policy():
+    # 4 logical shards in a chain 0-1-2-3, G=2: at most 2 entries per
+    # (device, dest device) -> packed with the bucketed budget 2 (< G^2)
+    chain = np.array([[1, -1], [0, 2], [1, 3], [2, -1]], np.int32)
+    assert packed_halo_rows(chain, 2) == 2
+    # fully-connected 4 logical shards: 4 entries per device pair = the
+    # dense G^2 tile; occupancy threshold keeps the dense path
+    clique = np.array([[1, 2, 3], [0, 2, 3], [0, 1, 3], [0, 1, 2]],
+                      np.int32)
+    assert packed_halo_rows(clique, 2) is None
+    # G=1 has no grouped exchange at all
+    assert packed_halo_rows(chain, 1) is None
+    # empty table: nothing to pack
+    assert packed_halo_rows(np.full((4, 2), -1, np.int32), 2) is None
+    # the knob: occupancy 1.0 accepts the clique only if the BUCKETED
+    # budget still beats G^2 rows — it does not (4 >= 4), dense stays
+    assert packed_halo_rows(clique, 2, occupancy=1.0) is None
+
+
+def test_packed_send_buffer_bytes_drop():
+    """Acceptance gate: on a G=2, S=2 interface-sized fixture the bytes
+    the packed all_to_all moves (payload + headers) are strictly below
+    the dense [S, G, G, I] block — asserted on the send buffer shapes.
+    Host-side only: the comm tables are numpy-built."""
+    vert, tet = cube_mesh(4)
+    cent = vert[tet].mean(axis=1)
+    part = np.clip((cent[:, 0] * 4).astype(np.int32), 0, 3)  # x-slab chain
+    l2g = [np.unique(tet[part == s_]) for s_ in range(4)]
+    g2l = []
+    for s_ in range(4):
+        mm = np.full(len(vert), -1, np.int64)
+        mm[l2g[s_]] = np.arange(len(l2g[s_]))
+        g2l.append(mm)
+    comms = build_interface_comms(tet.astype(np.int64), part, 4, l2g,
+                                  g2l)
+    G, S = 2, 2
+    M = packed_halo_rows(comms.nbr, G)
+    assert M is not None and M < G * G
+    I = comms.node_idx.shape[2]
+    tail_bytes = 4 * 4                     # analysis payload: 4 x f32
+    dense_bytes = S * G * G * I * tail_bytes
+    packed_bytes = S * M * (I * tail_bytes + 2 * 4)   # + int32 headers
+    assert packed_bytes < dense_bytes
+
+
+# ---------------------------------------------------------------------------
+# packed vs dense exchange parity (tiny hand-built tables)
+# ---------------------------------------------------------------------------
+@pytest.mark.slow
+def test_packed_exchange_matches_dense():
+    """Hand-built 4-logical-shard table on 2 devices with a same-device
+    pair per device, one cross-device pair, pad items and an idle
+    neighbor slot: the packed exchange must reproduce the dense recv
+    exactly (zeros on pads included).  slow: two shard_map compiles —
+    the tier-1 wall budget is full (ROADMAP note); the grouped-analysis
+    parity tests above re-prove the same equality end-to-end."""
+    from jax.sharding import PartitionSpec as P
+    from parmmg_tpu.utils.jaxcompat import shard_map
+
+    G, S, K, I, Pv = 2, 2, 2, 4, 8
+    # logical pairs: (0,1) same-device, (1,2) cross-device, (2,3)
+    # same-device; slot 1 of shards 0 and 3 is an idle (-1) neighbor
+    nbr = np.array([[1, -1], [0, 2], [3, 1], [2, -1]], np.int32)
+    rng = np.random.default_rng(7)
+    send_idx = rng.integers(0, Pv, size=(S * G, K, I)).astype(np.int32)
+    send_idx[0, 1] = -1                    # idle neighbor slot
+    send_idx[3, 1] = -1
+    send_idx[1, 0, 2:] = -1                # pad items inside a pair
+    send_idx[0, 0, 2:] = -1
+    vals = rng.normal(size=(S * G, Pv, 3)).astype(np.float32)
+
+    M = packed_halo_rows(nbr, G)
+    assert M is not None and M < G * G
+    dmesh = make_device_mesh(S)
+    spec = P("shard")
+
+    def run(fn):
+        def local(v, ni, nb):
+            return fn(v, ni, nb)
+        prog = jax.jit(shard_map(local, mesh=dmesh,
+                                 in_specs=(spec, spec, spec),
+                                 out_specs=spec, check_vma=False))
+        return np.asarray(prog(
+            shard_stacked(jnp.asarray(vals), dmesh),
+            shard_stacked(jnp.asarray(send_idx), dmesh),
+            shard_stacked(jnp.asarray(nbr), dmesh)))
+
+    dense = run(lambda v, ni, nb: halo_exchange_grouped(v, ni, nb, G))
+    packed = run(lambda v, ni, nb: halo_exchange_grouped_packed(
+        v, ni, nb, G, M))
+    assert dense.shape == packed.shape == (S * G, K, I, 3)
+    assert np.array_equal(dense, packed)
+    # pads stay zero; real same-device + cross-device rows carry data
+    assert np.all(packed[0, 1] == 0) and np.all(packed[3, 1] == 0)
+    assert np.any(packed[0, 0] != 0)       # same-device pair (0,1)
+    assert np.any(packed[1, 1] != 0)       # cross-device pair (1,2)
+
+
+# ---------------------------------------------------------------------------
+# grouped analysis parity + the G=2 driver run (slow tier)
+# ---------------------------------------------------------------------------
+def _setup(part_fn, n=4, nparts=4):
+    vert, tet = cube_mesh(n)
+    m = make_mesh(vert, tet, capP=2 * len(vert), capT=2 * len(tet))
+    # two material refs -> MG_REF edges where the surface refs differ
+    tref = 1 + (vert[tet].mean(axis=1)[:, 0] > 0.5).astype(np.int32)
+    trf = np.zeros(m.capT, np.int32)
+    trf[: len(tet)] = tref
+    m = dataclasses.replace(m, tref=jnp.asarray(trf))
+    m = analyze_mesh(m).mesh
+    is_b = (np.asarray(m.ftag) & C.MG_BDY) != 0
+    frf = np.where(is_b, trf[:, None], np.asarray(m.fref))
+    m = dataclasses.replace(m, fref=jnp.asarray(frf.astype(np.int32)))
+    met = jnp.full(m.capP, 0.4, m.vert.dtype)
+    vert_h, tet_h, _, _, _ = mesh_to_host(m)
+    part = part_fn(vert_h, tet_h, nparts)
+    s, ms, l2g = split_to_shards(m, met, part, nparts, return_l2g=True)
+    g2l = []
+    for s_ in range(nparts):
+        mm = np.full(len(vert_h), -1, np.int64)
+        mm[l2g[s_]] = np.arange(len(l2g[s_]))
+        g2l.append(mm)
+    comms = build_interface_comms(tet_h, part, nparts, l2g, g2l)
+    return s, comms, nparts
+
+
+def _part_morton(vert_h, tet_h, nparts):
+    cent = vert_h[tet_h].mean(axis=1)
+    return fix_contiguity(tet_h, morton_partition(cent, nparts))
+
+
+def _part_slabs(vert_h, tet_h, nparts):
+    cent = vert_h[tet_h].mean(axis=1)
+    return np.clip((cent[:, 0] * nparts).astype(np.int32), 0,
+                   nparts - 1)
+
+
+def _assert_parity(stacked, comms, S, dmesh):
+    capP = stacked.vert.shape[1]
+    glo = extend_numbering(comms, [capP] * S)
+    host_out = refresh_shard_analysis(stacked, comms, S, C.ANGEDG,
+                                      glo=[g.copy() for g in glo])
+    dev_out = refresh_shard_analysis_device(stacked, comms, S, C.ANGEDG,
+                                            glo, dmesh)
+    assert dev_out is not None, "grouped device path overflowed"
+    vm = np.asarray(stacked.vmask)
+    tm = np.asarray(stacked.tmask)
+    vt_h, vt_d = np.asarray(host_out.vtag), np.asarray(dev_out.vtag)
+    et_h, et_d = np.asarray(host_out.etag), np.asarray(dev_out.etag)
+    for sh in range(S):
+        bad_v = np.where(vm[sh] & (vt_h[sh] != vt_d[sh]))[0]
+        assert len(bad_v) == 0, (
+            f"shard {sh}: {len(bad_v)} vtag mismatches, first "
+            f"{bad_v[:5]}: host {vt_h[sh][bad_v[:5]]} "
+            f"dev {vt_d[sh][bad_v[:5]]}")
+        bad_e = np.where((et_h[sh] != et_d[sh]) & tm[sh][:, None])
+        assert len(bad_e[0]) == 0, (
+            f"shard {sh}: {len(bad_e[0])} etag mismatches")
+
+
+@pytest.mark.slow
+def test_grouped_analysis_matches_host_dense():
+    """G=2 on 2 devices, morton partition (fully-connected neighbors ->
+    dense grouped exchange): bit-for-bit host parity."""
+    s, comms, S = _setup(_part_morton)
+    assert packed_halo_rows(comms.nbr, 2) is None    # dense route
+    dmesh = make_device_mesh(2)
+    _assert_parity(shard_stacked(s, dmesh), comms, S, dmesh)
+
+
+@pytest.mark.slow
+def test_grouped_analysis_matches_host_packed():
+    """G=2 on 2 devices, x-slab chain partition (sparse neighbors ->
+    the packed grouped exchange is selected): bit-for-bit host parity
+    through the compacted wire layout."""
+    s, comms, S = _setup(_part_slabs)
+    assert packed_halo_rows(comms.nbr, 2) is not None   # packed route
+    dmesh = make_device_mesh(2)
+    _assert_parity(shard_stacked(s, dmesh), comms, S, dmesh)
+
+
+@pytest.mark.slow
+def test_grouped_refresh_taken_on_g2_driver_run():
+    """Acceptance gate: a G=2 driver run performs the analysis refresh
+    ON DEVICE — the host path (refresh_shard_analysis) is unreachable
+    unless the KS budget overflows, which this fixture cannot trigger.
+    The host refresh is replaced with a tripwire for the whole run."""
+    from parmmg_tpu.parallel import dist as dist_mod
+    from parmmg_tpu.utils.compilecache import ledger_snapshot
+
+    vert, tet = cube_mesh(2)
+    m = make_mesh(vert, tet, capP=6 * len(vert), capT=6 * len(tet))
+    m = analyze_mesh(m).mesh
+    met = jnp.full(m.capP, 0.4, m.vert.dtype)
+
+    orig = dist_mod.refresh_shard_analysis
+
+    def tripwire(*a, **k):
+        raise AssertionError(
+            "host analysis refresh reached on a G>1 run without a "
+            "KS-budget overflow")
+
+    dist_mod.refresh_shard_analysis = tripwire
+    try:
+        out, met_m, part = dist_mod.distributed_adapt_multi(
+            m, met, 4, niter=2, cycles=2, n_devices=2)
+    finally:
+        dist_mod.refresh_shard_analysis = orig
+    assert int(np.asarray(out.tmask).sum()) > 0
+    led = ledger_snapshot()
+    assert led.get("dist.analysis_grouped", {}).get("calls", 0) >= 1
+    # conformity of the merged result (numpy-side)
+    vert_h, tet_h, _, _, _ = mesh_to_host(out)
+    p = vert_h[tet_h]
+    vol = np.einsum("ij,ij->i", p[:, 1] - p[:, 0],
+                    np.cross(p[:, 2] - p[:, 0], p[:, 3] - p[:, 0]))
+    assert (vol > 0).all()

@@ -8,6 +8,7 @@ what 3-2/2-3 swaps alone reach.
 """
 import numpy as np
 import jax.numpy as jnp
+import pytest
 
 from parmmg_tpu.core.mesh import make_mesh, tet_volumes
 from parmmg_tpu.ops.adjacency import build_adjacency, check_adjacency
@@ -70,6 +71,28 @@ def test_degree3_not_touched():
     met = jnp.full(m.capP, 2.0)
     res = swapgen_wave(m, met)
     assert int(res.nswap) == 0
+
+
+@pytest.mark.slow
+def test_jitted_entry_matches_eager():
+    """The governed module-level jit (ops.swapgen_wave — the cached
+    entry for eager tails, compile-governor satellite) must agree with
+    the traced-inline wave and land in the ledger.  slow: the one-shot
+    whole-wave compile takes ~a minute on the tier-1 CPU box."""
+    from parmmg_tpu.ops.swapgen import swapgen_wave_j
+    from parmmg_tpu.utils.compilecache import ledger_snapshot
+
+    m = _spindle(4)
+    met = jnp.full(m.capP, 2.0)
+    eager = swapgen_wave(m, met)
+    jitted = swapgen_wave_j(m, met)
+    assert int(jitted.nswap) == int(eager.nswap) == 1
+    assert np.array_equal(np.asarray(jitted.mesh.tet),
+                          np.asarray(eager.mesh.tet))
+    assert np.array_equal(np.asarray(jitted.mesh.tmask),
+                          np.asarray(eager.mesh.tmask))
+    rec = ledger_snapshot()["ops.swapgen_wave"]
+    assert rec["calls"] >= 1
 
 
 def test_boundary_edge_not_touched():
