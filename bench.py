@@ -268,6 +268,63 @@ def main() -> None:
                  "qmin": round(qmin_a, 4),
                  "qmean": round(qmean_a, 4)}
 
+    # ---- grouped-analysis extraction probe (ROADMAP decision input) -----
+    # dist_analysis_grouped extracts the [12*capT] record table twice per
+    # group per refresh (pack + tail) rather than persisting a
+    # [G, 12*capT] intermediate; extract2x_s = measured seconds of ONE
+    # extraction at the bench mesh's shape, so the redundant cost per
+    # refresh (~ G x this) is visible in every BENCH artifact and the
+    # fused single-pass variant can be justified (or dropped) from data.
+    extract2x_s = None
+    if os.environ.get("BENCH_EXTRACT2X", "1") == "1":
+        try:
+            from parmmg_tpu.parallel.analysis_dev import \
+                extract_probe_seconds
+            glo_p = jnp.arange(m.vert.shape[0], dtype=jnp.int32)
+            extract2x_s = round(extract_probe_seconds(m, glo_p), 5)
+        except Exception as e:          # probe must never kill the bench
+            print(f"bench: extract2x probe failed ({e!r})",
+                  file=sys.stderr)
+
+    # ---- quiet-group scheduler datapoint (opt-in: BENCH_GROUPED=1) ------
+    # a small grouped_adapt_pass with chunked dispatch, reporting the
+    # scheduler's saved-dispatch counters + active-group trajectory +
+    # pipeline segment times.  Opt-in because the group block is a fresh
+    # compile family on a cold cache; scripts/scale_big.py carries the
+    # same counters on the real grouped workload.
+    group_sched = None
+    if os.environ.get("BENCH_GROUPED", "0") == "1":
+        from parmmg_tpu.ops.adapt import AdaptStats
+        from parmmg_tpu.parallel.groups import grouped_adapt_pass
+        n_g = int(os.environ.get("BENCH_GROUPED_N", "6"))
+        chunk_prev = os.environ.get("PARMMG_GROUP_CHUNK")
+        os.environ.setdefault("PARMMG_GROUP_CHUNK", "1")
+        try:
+            vg, tg = cube_mesh(n_g)
+            mg = make_mesh(vg, tg, capP=4 * len(vg), capT=4 * len(tg))
+            mg = analyze_mesh(mg).mesh
+            hg = analytic_iso_metric(vg, "shock", h=1.5 / n_g)
+            kg = jnp.zeros(mg.capP, mg.vert.dtype).at[: len(hg)].set(
+                jnp.asarray(hg, mg.vert.dtype)).at[len(hg):].set(1.0)
+            st_g = AdaptStats()
+            t0 = time.perf_counter()
+            grouped_adapt_pass(mg, kg, 3, cycles=6, stats=st_g)
+            group_sched = {
+                "adapt_s": round(time.perf_counter() - t0, 3),
+                "dispatches": st_g.group_dispatches,
+                "saved_dispatches": st_g.group_dispatches_saved,
+                "groups_skipped": st_g.groups_skipped,
+                "active_groups_per_block":
+                    st_g.sched_extra.get("active_groups_per_block", []),
+                "pipeline_s": {
+                    k: round(v, 4)
+                    for k, v in st_g.sched_extra.items()
+                    if k.startswith("grp_")},
+            }
+        finally:
+            if chunk_prev is None:
+                os.environ.pop("PARMMG_GROUP_CHUNK", None)
+
     # ledger regression check against the previous round's artifact:
     # any entry point whose compiled-variant count GREW since the last
     # BENCH_r*.json is flagged in the JSON and on stderr (the bench-side
@@ -291,6 +348,11 @@ def main() -> None:
                   "sum_rate": round(mtets_sum, 4),
                   "narrow_cycles": narrow_cycles,
                   "aniso": aniso,
+                  # grouped-analysis double-extraction cost (seconds per
+                  # [12*capT] extraction at this mesh shape) + the
+                  # quiet-group scheduler datapoint (BENCH_GROUPED=1)
+                  "extract2x_s": extract2x_s,
+                  "group_sched": group_sched,
                   "device": str(jax.devices()[0].platform),
                   "fallback": os.environ.get(
                       "PARMMG_BENCH_FALLBACK", "") == "1",

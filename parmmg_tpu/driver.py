@@ -208,7 +208,7 @@ def parmmg_run(pm) -> tuple[Mesh, object, AdaptStats]:
                         else 0, stats=stats,
                         noinsert=info.noinsert, noswap=info.noswap,
                         nomove=info.nomove, hausd=hausd,
-                        ifc_layers=info.ifc_layers)
+                        ifc_layers=info.ifc_layers, timers=tim)
             except MemoryError:
                 mesh, met = backup
                 stats.status = C.PMMG_LOWFAILURE
@@ -398,6 +398,19 @@ def _finish_run(pm, mesh, met, stats, info, tim, bg_mesh, bg_fields,
     if info.imprim >= C.PMMG_VERB_QUAL:
         print_quality_report(mesh, met, info)
     if info.imprim >= C.PMMG_VERB_STEPS:
+        # quiet-group scheduler accounting (parallel/sched.py): the
+        # active g/G trajectory + the dispatches the compaction saved
+        # on the grouped path's chunked dispatch loop
+        if stats.group_dispatches or stats.group_dispatches_saved:
+            traj = stats.sched_extra.get("active_groups_per_block", [])
+            line = (f"  -- QUIET-GROUP SCHEDULER  "
+                    f"{stats.group_dispatches} group-block dispatches, "
+                    f"{stats.group_dispatches_saved} saved "
+                    f"({stats.groups_skipped} group-blocks skipped)")
+            if traj:
+                line += "; active g/block " + \
+                    ",".join(str(a) for a in traj)
+            print(line)
         print(tim.report())
         # compile-churn accounting (utils/compilecache): a steady state
         # whose ledger keeps growing is recompiling, not computing

@@ -17,7 +17,7 @@ frozen interfaces.
 """
 from __future__ import annotations
 
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 from functools import partial
 
 import jax
@@ -44,6 +44,15 @@ class AdaptStats:
     # PMMG_SUCCESS unless the run degraded (failed_handling contract:
     # PMMG_LOWFAILURE = something failed but a conforming mesh is saved)
     status: int = 0
+    # quiet-group scheduler instrumentation (parallel/sched.py via the
+    # grouped paths): chunked group-block dispatches executed / skipped
+    # by the scheduler, group-block slots skipped, and the free-form
+    # extra dict (active-group trajectories + pipeline segment seconds)
+    # that bench.py / scripts/scale_big.py surface in their artifacts
+    group_dispatches: int = 0
+    group_dispatches_saved: int = 0
+    groups_skipped: int = 0
+    sched_extra: dict = field(default_factory=dict)
 
     def __iadd__(self, other):
         self.nsplit += other.nsplit
@@ -53,6 +62,14 @@ class AdaptStats:
         self.cycles += other.cycles
         self.regrows += other.regrows
         self.status = max(self.status, other.status)
+        self.group_dispatches += other.group_dispatches
+        self.group_dispatches_saved += other.group_dispatches_saved
+        self.groups_skipped += other.groups_skipped
+        for k, v in other.sched_extra.items():
+            if isinstance(v, list):
+                self.sched_extra.setdefault(k, []).extend(v)
+            else:
+                self.sched_extra[k] = self.sched_extra.get(k, 0.0) + v
         return self
 
 

@@ -478,6 +478,51 @@ def shard_analysis_body_grouped(mesh_s: Mesh, glo_s, node_idx_s, nbr_s,
     return vtag_new, etag_new, ovf
 
 
+_EXTRACT_PROBE = None
+
+
+def extract_probe_seconds(mesh_g: Mesh, glo_g, repeats: int = 3) -> float:
+    """Wall-seconds for ONE [12*capT] record-table extraction, jitted
+    standalone (compile excluded; median of ``repeats`` runs).
+
+    Decision input for the grouped-analysis fused-single-pass follow-on
+    (ROADMAP): :func:`dist_analysis_grouped` extracts the record table
+    TWICE per group per refresh (pack phase + tail phase) to avoid
+    persisting a [G, 12*capT] intermediate across the lax.map, so the
+    redundant extraction cost per refresh is ~ G x this number — and the
+    fused variant is justified (or dropped) by comparing it against the
+    refresh wall time.  Surfaced as ``extract2x_s`` in the bench extra.
+
+    The probe reduces every record field to scalars so the measurement
+    covers the full extraction (gathers + cross products + the
+    interface classification) without paying an [R]-wide device->host
+    pull."""
+    import time
+    from ..utils.compilecache import governed
+
+    global _EXTRACT_PROBE
+    if _EXTRACT_PROBE is None:
+        @governed("analysis.extract_probe", budget=2)
+        @jax.jit
+        def _probe(m, g):
+            # scalar sinks only (keeps every extraction field live
+            # against DCE without an [R]-wide pull; int32 wrap is fine
+            # for a timing sink)
+            rec = _extract_records(m, g)
+            return (jnp.sum(rec.g_lo) + jnp.sum(rec.g_hi),
+                    jnp.sum(rec.nu), jnp.sum(rec.frf),
+                    jnp.sum(rec.loc_rec), jnp.sum(rec.sh_rec))
+        _EXTRACT_PROBE = _probe
+    out = _EXTRACT_PROBE(mesh_g, glo_g)
+    jax.block_until_ready(out)                   # compile + warm
+    ts = []
+    for _ in range(max(1, int(repeats))):
+        t0 = time.perf_counter()
+        jax.block_until_ready(_EXTRACT_PROBE(mesh_g, glo_g))
+        ts.append(time.perf_counter() - t0)
+    return float(np.median(ts))
+
+
 def dist_analysis(dmesh, angedg: float, KS: int):
     """Build the jitted SPMD analysis-refresh program for a device mesh.
 

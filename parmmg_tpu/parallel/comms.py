@@ -407,7 +407,8 @@ def halo_exchange_grouped(vals, send_idx, nbr, G: int,
 
 
 def packed_halo_rows(nbr: np.ndarray, G: int,
-                     occupancy: float | None = None) -> int | None:
+                     occupancy: float | None = None,
+                     state: dict | None = None) -> int | None:
     """Per-device-pair packed row budget for
     :func:`halo_exchange_grouped_packed`, or None when the dense
     [S, G, G, I] block should be kept.
@@ -422,6 +423,16 @@ def packed_halo_rows(nbr: np.ndarray, G: int,
     caller keeps it.  The returned budget is BUCKETED on the geo ladder
     (compile governor: per-pair counts drift every migration; an exact
     M would key a fresh compile per iteration).
+
+    ``state``: optional mutable dict carried by the caller across
+    comm-table rebuilds — the layout decision then becomes STICKY with
+    a hysteresis margin (knob PARMMG_HALO_PACK_HYST, default 0.05):
+    once a layout is chosen it only flips when the occupancy ratio
+    crosses the threshold by more than the margin, so a borderline mesh
+    cannot flip-flop dense<->packed compiles on every rebuild.  The
+    packed row budget M itself still re-buckets freely (the geo ladder
+    is the anti-churn layer for the WIDTH; hysteresis is the anti-churn
+    layer for the LAYOUT).  Stateless decide-per-call when None.
     """
     import os
     if G <= 1:
@@ -435,13 +446,28 @@ def packed_halo_rows(nbr: np.ndarray, G: int,
         for b in nbr[l][nbr[l] >= 0]:
             counts[l // G, int(b) // G] += 1
     mx = int(counts.max()) if counts.size else 0
-    if mx == 0 or mx > occupancy * G * G:
-        return None
-    from ..utils.compilecache import bucket
-    M = bucket(mx, floor=2, scheme="geo")
-    # after rounding, the packed layout must still beat the dense tile
-    # (headers ride along; require a strict row win)
-    return M if M < G * G else None
+    if mx == 0:
+        return None           # no traffic: no evidence, state untouched
+    r = mx / float(G * G)
+    use_packed = r <= occupancy
+    if state is not None:
+        hyst = float(os.environ.get("PARMMG_HALO_PACK_HYST", "0.05"))
+        prev = state.get("layout")
+        if prev == "packed":
+            use_packed = r <= occupancy + hyst
+        elif prev == "dense":
+            use_packed = r <= occupancy - hyst
+    M = None
+    if use_packed:
+        from ..utils.compilecache import bucket
+        M = bucket(mx, floor=2, scheme="geo")
+        # after rounding, the packed layout must still beat the dense
+        # tile (headers ride along; require a strict row win)
+        if M >= G * G:
+            M = None
+    if state is not None:
+        state["layout"] = "packed" if M is not None else "dense"
+    return M
 
 
 def halo_exchange_grouped_packed(vals, send_idx, nbr, G: int, M: int,

@@ -134,7 +134,16 @@ def dist_adapt_block(dmesh: DeviceMesh, swap_flags: tuple,
     that makes meshes far beyond one group's HBM feasible per chip.
 
     Returns fn(stacked_mesh, stacked_met, wave0) ->
-      (stacked_mesh, stacked_met, global_counts[n,4], any_overflow).
+      (stacked_mesh, stacked_met, global_counts[n,4],
+       active_groups[n], any_overflow).
+
+    ``active_groups[i]`` = number of LOGICAL shards that posted a
+    nonzero split+collapse+swap in cycle i (psum'd like the counters):
+    the per-group convergence signal is kept instead of being summed
+    away, so :func:`run_adapt_cycles` can drive its early-exit and its
+    verbose "active g/G" trajectory from per-group data — the SPMD
+    mirror of the quiet-group scheduler on the single-device grouped
+    path (parallel/sched.py).
     """
     from ..ops.adapt import adapt_cycle_impl
     spec = P("shard")
@@ -156,6 +165,7 @@ def dist_adapt_block(dmesh: DeviceMesh, swap_flags: tuple,
         if G == 1:
             mesh, met, cs = one_shard(_unstack(mesh_s), met_s[0], wave0)
             mesh_s, met_s = _restack(mesh), met[None]
+            act = (jnp.sum(cs[:, :3], axis=1) > 0).astype(jnp.int32)
         else:
             def body(args):
                 m, k = args
@@ -163,13 +173,16 @@ def dist_adapt_block(dmesh: DeviceMesh, swap_flags: tuple,
             mesh_s, met_s, cs_g = jax.lax.map(body, (mesh_s, met_s))
             cs = jnp.sum(cs_g, axis=0)                     # [n, 8]
             cs = cs.at[:, 4].set(jnp.max(cs_g[:, :, 4], axis=0))
+            act = jnp.sum((jnp.sum(cs_g[:, :, :3], axis=2) > 0
+                           ).astype(jnp.int32), axis=0)    # [n]
         ovf = jax.lax.pmax(jnp.max(cs[:, 4]), "shard")
         counts = jax.lax.psum(cs[:, :4], "shard")
-        return mesh_s, met_s, counts, ovf
+        nact = jax.lax.psum(act, "shard")
+        return mesh_s, met_s, counts, nact, ovf
 
     fn = shard_map(local_block, mesh=dmesh,
                    in_specs=(spec, spec, P()),
-                   out_specs=(spec, spec, P(), P()),
+                   out_specs=(spec, spec, P(), P(), P()),
                    check_vma=False)
     return governed("dist.adapt_block")(jax.jit(fn))
 
@@ -254,7 +267,8 @@ def dist_interface_check(dmesh: DeviceMesh, G: int = 1,
 
 def refresh_shard_analysis_device(stacked: Mesh, comms, n_shards: int,
                                   angedg: float, glo, dmesh,
-                                  cache: dict | None = None):
+                                  cache: dict | None = None,
+                                  pack_state: dict | None = None):
     """Device-resident analysis refresh (parallel/analysis_dev.py): the
     sort/segment reductions of the host path run jitted under shard_map,
     keyed by the persistent global numbering — no O(mesh) host pull.
@@ -284,7 +298,10 @@ def refresh_shard_analysis_device(stacked: Mesh, comms, n_shards: int,
     # dist_analysis compile each outer iteration
     KS = bucket(max(1024, 4 * comms.node_idx[0].size),
                 floor=1024, cap=12 * capT)
-    Mp = packed_halo_rows(comms.nbr, G) if G > 1 else None
+    # pack_state: sticky dense/packed layout across comm-table rebuilds
+    # (hysteresis; the multi-iteration driver threads one dict through)
+    Mp = packed_halo_rows(comms.nbr, G, state=pack_state) \
+        if G > 1 else None
     key = (angedg, KS, n_shards, G, Mp)
     if cache is not None and key in cache:
         fn = cache[key]
@@ -442,14 +459,17 @@ def dist_quality(dmesh: DeviceMesh):
 _IFC_CHECK_CACHE: dict = {}
 
 
-def check_interface_echo(stacked, met_s, comms, dmesh, vert_h, G: int = 1):
+def check_interface_echo(stacked, met_s, comms, dmesh, vert_h, G: int = 1,
+                         pack_state: dict | None = None):
     """On-device interface coordinate+metric echo (the production chkcomm
     guard, chkcomm_pmmg.c:815 role); raises on an ordering-contract
     violation.  G > 1 routes the exchange through the packed grouped
     layout when the measured occupancy says it beats the dense tile
-    (comms.packed_halo_rows)."""
+    (comms.packed_halo_rows; ``pack_state`` makes the layout decision
+    sticky across comm-table rebuilds — hysteresis)."""
     from .comms import packed_halo_rows
-    Mp = packed_halo_rows(comms.nbr, G) if G > 1 else None
+    Mp = packed_halo_rows(comms.nbr, G, state=pack_state) \
+        if G > 1 else None
     key = (tuple(d.id for d in np.asarray(dmesh.devices).flat), G, Mp)
     chk = _IFC_CHECK_CACHE.get(key)
     if chk is None:
@@ -506,9 +526,11 @@ def run_adapt_cycles(stacked, met_s, steps: DistSteps, cycles,
                       for cc in range(c, c + nblk))
         pres = tuple(cc < cycles - 2 for cc in range(c, c + nblk))
         step = steps.get(flags, pres)
-        stacked, met_s, counts, ovf = step(stacked, met_s,
-                                           jnp.asarray(c, jnp.int32))
+        stacked, met_s, counts, nact, ovf = step(
+            stacked, met_s, jnp.asarray(c, jnp.int32))
         ca = np.asarray(counts)                  # [nblk, 4]
+        na = np.asarray(nact)                    # [nblk] active groups
+        n_logical = stacked.tmask.shape[0]
         for i in range(nblk):
             cs = ca[i]
             if stats is not None:        # psum'd global counters
@@ -517,9 +539,14 @@ def run_adapt_cycles(stacked, met_s, steps: DistSteps, cycles,
                 stats.nswap += int(cs[2])
                 stats.nmoved += int(cs[3])
                 stats.cycles += 1
+                # per-group convergence trajectory (the SPMD mirror of
+                # the grouped path's active_groups_per_block)
+                stats.sched_extra.setdefault(
+                    "active_shards_per_cycle", []).append(int(na[i]))
             if verbose >= 3:
                 print(f"  {label} cycle {c + i}: split {cs[0]} "
-                      f"collapse {cs[1]} swap {cs[2]} move {cs[3]}")
+                      f"collapse {cs[1]} swap {cs[2]} move {cs[3]} "
+                      f"active {int(na[i])}/{n_logical} grp")
         if int(ovf) != 0:
             if regrow_state[0] >= MAX_SHARD_REGROWS:
                 m_, k_, p_ = merge_shards(stacked, met_s,
@@ -536,10 +563,11 @@ def run_adapt_cycles(stacked, met_s, steps: DistSteps, cycles,
             regrow_state[0] += 1
             continue        # re-run the block: truncated winners rerun
         c += nblk
-        # convergence: a swap-inclusive (or noswap) cycle with zero
-        # topological ops ends the pass
-        if any((flags[i] or noswap) and
-               int(ca[i][0]) + int(ca[i][1]) + int(ca[i][2]) == 0
+        # convergence: a swap-inclusive (or noswap) cycle on which
+        # EVERY logical group posted zero topological ops ends the pass
+        # (active_groups == 0 is exactly the summed-zero rule, read
+        # from the per-group counts instead of the psum'd total)
+        if any((flags[i] or noswap) and int(na[i]) == 0
                for i in range(nblk)):
             break
     return stacked, met_s
@@ -754,7 +782,12 @@ def distributed_adapt_multi(mesh: Mesh, met, n_shards: int,
         glo[s_][: len(l2g[s_])] = l2g[s_]
     top = len(vert_h)
 
-    check_interface_echo(stacked, met_s, comms, dmesh, vert_h, G=G)
+    # sticky dense/packed halo-layout decision across comm-table
+    # rebuilds (comms.packed_halo_rows hysteresis): ONE state dict
+    # threaded through every packed-layout decision of this run
+    pack_state: dict = {}
+    check_interface_echo(stacked, met_s, comms, dmesh, vert_h, G=G,
+                         pack_state=pack_state)
 
     steps = DistSteps(dmesh, do_smooth=not nomove,
                       do_insert=not noinsert, hausd=hausd, G=G)
@@ -848,7 +881,8 @@ def distributed_adapt_multi(mesh: Mesh, met, n_shards: int,
         # path below is the KS-budget-overflow fallback ONLY, so the
         # steady-state G>1 loop performs zero O(mesh) host pulls
         st2 = refresh_shard_analysis_device(
-            stacked, comms, n_shards, ang, glo, dmesh, cache=ana_cache)
+            stacked, comms, n_shards, ang, glo, dmesh, cache=ana_cache,
+            pack_state=pack_state)
         views = None
         if st2 is not None:
             stacked = st2
@@ -944,7 +978,8 @@ def distributed_adapt_multi(mesh: Mesh, met, n_shards: int,
                                 np.stack(glo).astype(np.int32))
                         stacked = rebuild_shards(stacked)
                         check_interface_echo(stacked, met_s, comms,
-                                             dmesh, vert_h, G=G)
+                                             dmesh, vert_h, G=G,
+                                             pack_state=pack_state)
                 elif verbose >= 1:
                     print(f"  it {it}: band budgets exceeded — "
                           "falling back to the full-view path")
@@ -990,7 +1025,8 @@ def distributed_adapt_multi(mesh: Mesh, met, n_shards: int,
                         touched=touched, verbose=verbose)
                     stacked = rebuild_shards(stacked)
                     check_interface_echo(stacked, met_s, comms, dmesh,
-                                         vert_h, G=G)
+                                         vert_h, G=G,
+                                         pack_state=pack_state)
                 if use_band:    # resync the device numbering copy
                     glo_d = jnp.asarray(np.stack(glo).astype(np.int32))
                     shared_prev = _shared_gids(comms, glo, n_shards)

@@ -172,22 +172,109 @@ def _pad_groups(tree, g_new: int):
     return jax.tree.map(pad, tree)
 
 
+def _pipeline_chunks(fn, stacked, met_s, wave, plans, tim):
+    """Double-buffered chunked dispatch over gathered group-index slices.
+
+    ``plans``: [(idx_exec [chunk], nreal)] from the quiet-group
+    scheduler (parallel/sched.py); the SAME compiled [chunk, ...]
+    program runs on every gathered slice, so compaction adds zero new
+    shape families.  The legacy loop was a serial
+    upload -> compute -> sync -> download train per chunk; here chunk
+    k+1's host gather + device upload + dispatch are issued BEFORE
+    blocking on chunk k, so host staging and device->host pulls overlap
+    device compute (two chunks in flight, bounding peak device memory
+    at 2 chunk states — the HBM discipline of the chunked mode).  The
+    per-chunk counter sync is deferred into the chunk's drain: counts
+    ride the same batched pull as the mesh download, after the next
+    chunk is already enqueued.
+
+    Writeback generalizes the old contiguous ``_assign`` to index
+    lists: only the first ``nreal`` rows of a padded tail plan are
+    scattered back.  ``tim`` (utils.timers.Timers) records the
+    upload / compute-wait / download / writeback split; the compute
+    wait of a drained chunk overlaps the next chunk's execution, so
+    the recorded segments are the PIPELINE's residual stalls, not raw
+    kernel time.
+
+    PARMMG_GROUP_PIPELINE=0 serializes (drain each chunk before
+    enqueuing the next): double-buffering holds TWO chunk states on
+    device instead of the legacy loop's one, and a PARMMG_GROUP_CHUNK
+    tuned against the HBM ceiling (the 16 GB-chip OOM note below) may
+    need the legacy memory bound back rather than a smaller chunk.
+
+    Returns the per-plan host count arrays (trimmed to nreal), in plan
+    order."""
+    import os
+    depth = 2 if os.environ.get("PARMMG_GROUP_PIPELINE", "1") != "0" \
+        else 1
+    out = [None] * len(plans)
+
+    def drain(p):
+        pi, idx, nreal, m, k, cnt = p
+        with tim("compute"):
+            jax.block_until_ready(cnt)
+        with tim("download"):
+            mh = jax.tree.map(lambda s: np.asarray(s), m)
+            kh = np.asarray(k)
+            out[pi] = np.asarray(cnt)[:nreal]
+        with tim("writeback"):
+            rows = idx[:nreal]
+
+            def w(d, s):
+                d[rows] = s[:nreal]
+                return d
+            jax.tree.map(w, stacked, mh)
+            met_s[rows] = kh[:nreal]
+
+    pending = None
+    for pi, (idx, nreal) in enumerate(plans):
+        with tim("upload"):
+            sl = jax.tree.map(lambda a: jnp.asarray(a[idx]), stacked)
+            kl = jnp.asarray(met_s[idx])
+        m, k, cnt = fn(sl, kl, wave)
+        if pending is not None:
+            drain(pending)
+        pending = (pi, idx, nreal, m, k, cnt)
+        if depth == 1:
+            drain(pending)
+            pending = None
+    if pending is not None:
+        drain(pending)
+    return out
+
+
 def grouped_adapt_pass(mesh: Mesh, met, ngroups: int, cycles: int = 12,
                        part: np.ndarray | None = None,
                        verbose: int = 0, stats=None,
                        noinsert: bool = False, noswap: bool = False,
                        nomove: bool = False, hausd: float | None = None,
-                       polish: bool = False, cap_mult: float = 3.0):
+                       polish: bool = False, cap_mult: float = 3.0,
+                       timers=None):
     """One outer pass: split into groups, run adapt cycles with lax.map
     over the group axis, merge.  Returns (mesh, met, part_of_merged).
 
     The per-group program is the SAME adapt_cycle_impl as the whole-mesh
     path (frozen MG_PARBDY group seams make it correct); the map axis
     serializes groups so HBM holds one group's working set at a time.
+
+    Quiet-group scheduler (parallel/sched.py, PARMMG_GROUP_SCHED=0 to
+    disable): per-group counts mark groups quiet once a swap-inclusive
+    block is a no-op for them, and subsequent chunked dispatches gather
+    only the ACTIVE indices — same compiled [chunk, ...] program, fewer
+    executions of it.  Skipping is bit-for-bit exact (frozen seams +
+    deterministic waves make a zero-op state a fixed point; see the
+    sched module docstring for the prescreen-level and regrow caveats).
+    Chunked dispatches ride a double-buffered pipeline
+    (:func:`_pipeline_chunks`); its upload/compute/download/writeback
+    split lands in ``timers`` (driver reporting) and, with the
+    skipped-group / saved-dispatch counters and the active-group
+    trajectory, in ``stats.sched_extra``.
     """
     from ..ops.adapt import default_cycle_block
+    from ..utils.timers import Timers
     from .partition import morton_partition, fix_contiguity
     from .distribute import split_to_shards, merge_shards, grow_shards
+    from .sched import QuietGroupScheduler
     from ..core.mesh import mesh_to_host
 
     vert_h, tet_h, _, _, _ = mesh_to_host(mesh)
@@ -226,27 +313,19 @@ def grouped_adapt_pass(mesh: Mesh, met, ngroups: int, cycles: int = 12,
                                          cap_mult=cap_mult)
 
     def _assign(dst_tree, src_tree, g0):
-        """Write a chunk's device results back into the host state."""
+        """Write a chunk's device results back into the host state
+        (contiguous-slice legacy form; the scheduler path scatters by
+        index list inside :func:`_pipeline_chunks`)."""
         def w(d, s):
             d[g0:g0 + chunk] = np.asarray(s)
             return d
         jax.tree.map(w, dst_tree, src_tree)
 
-    def _run_chunked(fn, stacked, met_s, wave):
-        """Apply a per-chunk jitted block over the group axis."""
-        if not chunk:
-            return fn(stacked, met_s, wave)
-        cs = []
-        for g0 in range(0, g_exec, chunk):
-            sl = jax.tree.map(lambda a: jnp.asarray(a[g0:g0 + chunk]),
-                              stacked)
-            kl = jnp.asarray(met_s[g0:g0 + chunk])
-            m, k, cnt = fn(sl, kl, wave)
-            _assign(stacked, m, g0)
-            met_s[g0:g0 + chunk] = np.asarray(k)
-            cs.append(np.asarray(cnt))
-        return stacked, met_s, np.concatenate(cs)
-
+    sched = QuietGroupScheduler(ngroups, g_exec, chunk)
+    # pipeline segment timers on a LOCAL registry: folded into
+    # stats.sched_extra and (prefixed) into the caller's Timers at the
+    # end, so the driver report shows the transfer/compute split
+    ltim = Timers()
     block = default_cycle_block(stacked.vert)
     c = 0
     regrows = 0
@@ -258,9 +337,25 @@ def grouped_adapt_pass(mesh: Mesh, met, ngroups: int, cycles: int = 12,
                       for cc in range(c, c + nblk))
         pres = tuple(cc < cycles - 2 for cc in range(c, c + nblk))
         step = _group_block(flags, pres, nomove, noinsert, hausd)
-        stacked, met_s, counts = _run_chunked(
-            step, stacked, met_s, jnp.asarray(c, jnp.int32))
-        cs = np.asarray(counts).sum(axis=0)       # [n, 6] over groups
+        swap_inc = any(flags) or noswap
+        pres_all_on = all(pres)
+        wave = jnp.asarray(c, jnp.int32)
+        act, plans = sched.plan_block(pres_all_on)
+        if chunk:
+            parts = _pipeline_chunks(step, stacked, met_s, wave, plans,
+                                     ltim)
+            counts_act = np.concatenate(parts) if parts else \
+                np.zeros((0, nblk, 8), np.int32)
+            if verbose >= 2 and sched.enabled:
+                print(f"  grp block {c}..{c + nblk - 1}: active "
+                      f"{len(act)}/{g_exec} groups, {len(plans)} "
+                      "dispatches")
+        else:
+            stacked, met_s, counts = step(stacked, met_s, wave)
+            counts_act = np.asarray(counts)     # [g_exec, nblk, 8]
+        sched.record_block(act, counts_act, swap_inc, pres_all_on)
+        # quiet groups contribute exact zeros (that is what marked them)
+        cs = counts_act.sum(axis=0, dtype=np.int64)     # [nblk, 8]
         for i in range(nblk):
             tot = cs[i]
             if stats is not None:
@@ -308,12 +403,17 @@ def grouped_adapt_pass(mesh: Mesh, met, ngroups: int, cycles: int = 12,
                 stacked, met_s = grow_shards(stacked, met_s, 2 * capP,
                                              2 * capT)
             regrows += 1
+            # the wave top-K budgets scale with capT: every quiet proof
+            # is stale at the new capacity — reactivate the full set
+            # (truncated winners must rerun)
+            sched.on_regrow()
             continue        # re-run the block: truncated winners rerun
         c += nblk
         if any((flags[i] or noswap) and
                int(cs[i][0]) + int(cs[i][1]) + int(cs[i][2]) == 0
                for i in range(nblk)):
             break
+    pol_traj: list[int] = []
     if polish and not (noinsert and noswap and nomove):
         # grouped bad-element pass: sliver_polish per group under the
         # same lax.map regime (seams stay frozen; the outer-iteration
@@ -364,9 +464,44 @@ def grouped_adapt_pass(mesh: Mesh, met, ngroups: int, cycles: int = 12,
                     print("grouped polish worker failed "
                           f"(rc={r.returncode}); skipping grouped "
                           "polish\n" + r.stderr[-2000:], file=_sys.stderr)
+        elif chunk and sched.enabled:
+            # quiet-group polish: wave-major over COMPACTED active
+            # chunks, retiring each group at its own collapse+swap==0
+            # point — the per-group form of the legacy loop's per-chunk
+            # break (identical to it at chunk granularity 1; the old
+            # chunk-coupled break let a chunk-mate's work extend a quiet
+            # group's wave count, an artifact the compaction drops).
+            # All groups re-enter here: polish ops (sliver collapses,
+            # swapgen, opt-q smoothing) are a different candidate class
+            # than the cycle loop, so cycle-quiet proves nothing.
+            # Trade-off vs the legacy chunk-resident loop: a group
+            # active for w waves is shipped w times instead of once —
+            # paid back by retirement shrinking later waves and by the
+            # pipeline overlapping the transfers; the TPU in-session
+            # case keeps the legacy loop via PARMMG_GROUP_SCHED=0 (the
+            # default TPU polish rides the subprocess worker anyway).
+            from .sched import chunk_plans
+            pol_act = np.arange(ngroups)
+            for w in range(4):
+                if not len(pol_act):
+                    break
+                plans = chunk_plans(pol_act, chunk)
+                sched.dispatches += len(plans)
+                parts = _pipeline_chunks(
+                    polish_block, stacked, met_s,
+                    jnp.asarray(2000 + w, jnp.int32), plans, ltim)
+                cnts = np.concatenate(parts)          # [n_act, 4]
+                pol_traj.append(len(pol_act))
+                tot = cnts.sum(axis=0, dtype=np.int64)
+                if verbose >= 2:
+                    print(f"  grp polish w{w}: collapse {int(tot[0])} "
+                          f"swap {int(tot[1])} move {int(tot[2])} over "
+                          f"{len(pol_act)} active groups")
+                pol_act = pol_act[(cnts[:, 0] + cnts[:, 1]) > 0]
         elif chunk:
-            # per-chunk wave loop: each chunk polishes to ITS quiet
-            # point while resident, one upload/download per chunk total
+            # per-chunk wave loop (PARMMG_GROUP_SCHED=0 legacy): each
+            # chunk polishes to ITS quiet point while resident, one
+            # upload/download per chunk total
             for g0 in range(0, g_exec, chunk):
                 sl = jax.tree.map(
                     lambda a: jnp.asarray(a[g0:g0 + chunk]), stacked)
@@ -393,6 +528,24 @@ def grouped_adapt_pass(mesh: Mesh, met, ngroups: int, cycles: int = 12,
                           f"swap {int(tot[1])} move {int(tot[2])}")
                 if int(tot[0]) == 0 and int(tot[1]) == 0:
                     break
+    # fold the scheduler instrumentation: counters + the active-group
+    # trajectory into AdaptStats.sched_extra (bench/SCALE artifacts),
+    # the pipeline segment times into the caller's Timers (driver
+    # report) under a "grp <segment>" prefix
+    if stats is not None:
+        stats.group_dispatches += sched.dispatches
+        stats.group_dispatches_saved += sched.saved_dispatches
+        stats.groups_skipped += sched.skipped_group_blocks
+        se = stats.sched_extra
+        se.setdefault("active_groups_per_block", []).extend(
+            sched.active_per_block)
+        if pol_traj:
+            se.setdefault("polish_active_per_wave", []).extend(pol_traj)
+        for k, v in ltim.acc.items():
+            se[f"grp_{k}_s"] = se.get(f"grp_{k}_s", 0.0) + v
+    if timers is not None:
+        for k, v in ltim.acc.items():
+            timers.add(f"grp {k}", v, ltim.count[k])
     if chunk:
         # merge on the CPU backend: merge_shards rebuilds adjacency at
         # MERGED-mesh width — a whole-mesh device program that OOMs the
@@ -407,7 +560,7 @@ def grouped_adapt(mesh: Mesh, met, target_size: int, niter: int = 3,
                   cycles: int = 12, verbose: int = 0, stats=None,
                   noinsert: bool = False, noswap: bool = False,
                   nomove: bool = False, hausd: float | None = None,
-                  ifc_layers: int = 2):
+                  ifc_layers: int = 2, timers=None):
     """The two-level outer loop on one device: grouped passes with
     interface displacement between them (the rank-level loop of
     libparmmg1.c:636-948 collapsed onto one device, groups as the only
@@ -435,7 +588,8 @@ def grouped_adapt(mesh: Mesh, met, target_size: int, niter: int = 3,
         mesh, met, part_m = grouped_adapt_pass(
             mesh, met, ngroups, cycles=cycles, part=part,
             verbose=verbose, stats=stats, noinsert=noinsert,
-            noswap=noswap, nomove=nomove, hausd=hausd)
+            noswap=noswap, nomove=nomove, hausd=hausd,
+            timers=timers)
         if it + 1 < max(1, niter):
             _, tet_h, _, _, _ = mesh_to_host(mesh)
             part = move_interfaces(tet_h, part_m, ngroups,

@@ -1,0 +1,176 @@
+"""Quiet-group scheduler: convergence-aware active-set compaction.
+
+SCALE_r03 measured the grouped adapt pass at 97.6% of a 1M-tet run, and
+its per-cycle op counts collapse across cycles — yet the chunked
+dispatch loop of ``grouped_adapt_pass`` re-shipped EVERY group through
+EVERY cycle block (host gather + device upload + compute + counter sync
++ download), even for groups that posted zero ops blocks ago.  The
+per-group counts were summed away before anyone looked at them.
+
+This module is the host-side bookkeeping that fixes that: per-group
+counts mark groups *quiet*, and from then on the active group indices
+are compacted into dense chunks — the SAME compiled ``[chunk, ...]``
+program runs on gathered slices (zero new shapes, zero new
+compile-ledger families), it just runs on fewer of them.
+
+Exactness contract (why skipping is bit-for-bit, not approximate):
+
+- group seams are frozen (MG_PARBDY — the split_to_shards freeze
+  contract), so a group that posts zero ops cannot be re-dirtied by its
+  neighbors within a pass; the reference's rank-level loop
+  (libparmmg1.c:636-948) has the same convergence structure;
+- every wave kernel is a deterministic function of (mesh, met) alone —
+  the smoothing wave's hash rotation only permutes priorities among
+  vertices that already pass the improvement gate, and the gate is
+  geometry-only — so a block that posts zero split+collapse+swap+move
+  leaves the group state a *fixed point*: re-running any weaker-or-equal
+  block on it is byte-identity;
+- "weaker-or-equal" is tracked as two quiet levels, because the cycle
+  scheduler emits two block classes: prescreen-ON sizing blocks and the
+  final prescreen-OFF polish blocks whose exact split veto re-evaluates
+  candidates the approximate prescreen over-vetoed (ops/split.py, ADVICE
+  r3).  Zero under a swap-inclusive prescreen-on block only proves the
+  group inert for further prescreen-on blocks (``LEVEL_PRE``); zero
+  under a swap-inclusive block containing a prescreen-off cycle proves
+  it inert for everything (``LEVEL_FULL``).  Swap kernels and smoothing
+  do not read the prescreen, so the pres-off proof subsumes the pres-on
+  one;
+- a capacity regrow invalidates every proof: the top-K wave budgets
+  scale with capT, so a group whose winners were budget-truncated at the
+  old capacity can post fresh ops at the new one — ``on_regrow``
+  reactivates the full set (truncated winners must rerun), exactly like
+  the always-dispatch path's block rerun;
+- dead pad groups (the chunk-alignment padding of grouped_adapt_pass)
+  are fixed points by construction (all masks False) and are never
+  dispatched.
+
+``PARMMG_GROUP_SCHED=0`` is the escape hatch back to always-dispatch.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+LEVEL_ACTIVE = 0   # must dispatch
+LEVEL_PRE = 1      # proven zero under a swap-inclusive prescreen-ON block
+LEVEL_FULL = 2     # proven zero under a swap-inclusive prescreen-OFF block
+
+
+def sched_enabled() -> bool:
+    """PARMMG_GROUP_SCHED knob (default on)."""
+    import os
+    return os.environ.get("PARMMG_GROUP_SCHED", "1") != "0"
+
+
+def chunk_plans(act: np.ndarray, chunk: int) -> list:
+    """Compact active group indices into dense [chunk]-sized plans.
+
+    Returns [(idx_exec [chunk], nreal)]: a short tail plan is padded by
+    repeating its last real index so every dispatch keeps the compiled
+    [chunk, ...] shape; the duplicate rows compute the same result and
+    only the first ``nreal`` rows are written back."""
+    plans = []
+    for i in range(0, len(act), chunk):
+        idx = np.asarray(act[i:i + chunk])
+        nreal = len(idx)
+        if nreal < chunk:
+            idx = np.concatenate(
+                [idx, np.repeat(idx[-1:], chunk - nreal)])
+        plans.append((idx, nreal))
+    return plans
+
+
+class QuietGroupScheduler:
+    """Active-set bookkeeping for one grouped adapt pass.
+
+    ``g_exec`` >= ``ngroups``: the pad-aligned executable group count
+    (pad groups are born quiet).  ``chunk`` = groups per dispatch
+    (0 = one unchunked dispatch; compaction then cannot change the
+    dispatch shape and the scheduler only records the trajectory)."""
+
+    def __init__(self, ngroups: int, g_exec: int, chunk: int,
+                 enabled: bool | None = None):
+        if enabled is None:
+            enabled = sched_enabled()
+        self.ngroups = int(ngroups)
+        self.g_exec = int(g_exec)
+        self.chunk = int(chunk)
+        # compaction needs per-chunk dispatches to have fewer of them
+        self.enabled = bool(enabled) and self.chunk > 0
+        self.level = np.zeros(self.g_exec, np.int8)
+        self.level[self.ngroups:] = LEVEL_FULL     # dead pad groups
+        self.dispatches = 0
+        self.saved_dispatches = 0
+        self.skipped_group_blocks = 0
+        self.active_per_block: list[int] = []
+
+    # ---- block planning --------------------------------------------------
+    def _skip_level(self, pres_all_on: bool) -> int:
+        return LEVEL_PRE if pres_all_on else LEVEL_FULL
+
+    def plan_block(self, pres_all_on: bool):
+        """Plan one cycle block: returns (act, plans).
+
+        ``act``: group indices to dispatch, in plan order.  ``plans``:
+        [(idx_exec, nreal)] chunk plans (empty when every group is
+        quiet).  Dispatch/saved counters and the active-group trajectory
+        are accounted here; the always-dispatch baseline is
+        ceil(g_exec / chunk) dispatches per block."""
+        skip = self._skip_level(pres_all_on)
+        if self.enabled:
+            act = np.where(self.level < skip)[0]
+        else:
+            act = np.arange(self.g_exec)
+        self.active_per_block.append(
+            int(np.sum(self.level[:self.ngroups] < skip)))
+        if self.chunk:
+            base = -(-self.g_exec // self.chunk)
+            plans = chunk_plans(act, self.chunk) if len(act) else []
+        else:
+            base = 1
+            plans = [(act, len(act))] if len(act) else []
+        self.dispatches += len(plans)
+        # saved vs the always-dispatch baseline, which ships the dead
+        # pad groups too — skipping those IS a real dispatch saving
+        self.saved_dispatches += base - len(plans)
+        # ...but the skipped-GROUP counter reports convergence, so it
+        # counts REAL groups only (pads are dead at birth, not wins)
+        self.skipped_group_blocks += \
+            self.ngroups - int(np.sum(np.asarray(act) < self.ngroups))
+        return act, plans
+
+    # ---- quiet marking ---------------------------------------------------
+    def record_block(self, act: np.ndarray, counts: np.ndarray,
+                     swap_inclusive: bool, pres_all_on: bool) -> None:
+        """Mark groups quiet from a dispatched block's per-group counts.
+
+        ``counts``: [n_act, nblk, >=5] (split, collapse, swap, moved,
+        overflow, ...).  A group is quiet only when the WHOLE block was
+        a no-op for it — including moves (the fixed-point requirement)
+        and overflow (a truncated winner set witnesses nothing) — and
+        the block was swap-inclusive (``swap_inclusive`` = any swap
+        cycle, or -noswap, mirroring the global convergence rule).
+
+        The ``deferred`` column (6) is deliberately NOT part of the
+        proof: deferred marks top-K budget cuts, and the budgets are
+        constant across blocks (budget_div=8; only a capacity regrow
+        changes them, which reactivates everything).  Split, collapse
+        and swap take no wave input, so on an unchanged state they
+        re-select the identical (possibly empty) winner set every
+        block — a deferred-but-zero-op state is still a fixed point.
+        The only wave-rotated kernel is smoothing, and moved == 0
+        proves its geometry-only improvement gate rejects every
+        vertex, which no later wave's priority rotation can change."""
+        if not swap_inclusive or len(act) == 0:
+            return
+        c = np.asarray(counts)
+        zero = c[..., :5].reshape(len(act), -1).sum(
+            axis=1, dtype=np.int64) == 0
+        lvl = LEVEL_PRE if pres_all_on else LEVEL_FULL
+        sel = np.asarray(act)[zero]
+        self.level[sel] = np.maximum(self.level[sel], lvl)
+
+    def on_regrow(self) -> None:
+        """Capacity regrow: every proof is stale (the top-K budgets
+        scale with capT — budget-truncated winners must rerun).  Pad
+        groups stay quiet (dead at any capacity)."""
+        self.level[:self.ngroups] = LEVEL_ACTIVE

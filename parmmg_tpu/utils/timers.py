@@ -38,6 +38,16 @@ class Timers:
             self.acc[path] = self.acc.get(path, 0.0) + dt
             self.count[path] = self.count.get(path, 0) + 1
 
+    def add(self, name: str, seconds: float, count: int = 1) -> None:
+        """Fold an externally-measured duration into the registry at
+        the current nesting path.  The grouped chunk pipeline
+        (parallel/groups._pipeline_chunks) measures its
+        upload/compute/download/writeback segments on a local Timers
+        and absorbs them into the driver's reporting instance here."""
+        path = "/".join([p for p, _ in self._stack] + [name])
+        self.acc[path] = self.acc.get(path, 0.0) + float(seconds)
+        self.count[path] = self.count.get(path, 0) + int(count)
+
     def report(self, min_s: float = 0.0) -> str:
         lines = []
         for k in sorted(self.acc):

@@ -72,6 +72,69 @@ jax.config.update("jax_platforms", "cpu")
 import numpy as np  # noqa: E402
 
 
+def grouped_sched_gate() -> int:
+    """Quiet-group scheduler compile-family gate: a chunked grouped
+    pass with the scheduler ON must introduce ZERO new compile families
+    versus the always-dispatch path — compaction gathers group slices
+    for the SAME compiled [chunk, ...] program, so the second run below
+    (same process, jit caches warm from the scheduler-off run) may not
+    compile anything new under any ``groups.*`` entry point."""
+    import jax.numpy as jnp
+    from parmmg_tpu.core.mesh import make_mesh
+    from parmmg_tpu.ops.analysis import analyze_mesh
+    from parmmg_tpu.parallel.groups import grouped_adapt_pass
+    from parmmg_tpu.utils.compilecache import (ledger_snapshot,
+                                               ledger_violations,
+                                               reset_ledger)
+    from parmmg_tpu.utils.fixtures import cube_mesh
+
+    def run(sched: str):
+        os.environ["PARMMG_GROUP_SCHED"] = sched
+        vert, tet = cube_mesh(2)
+        m = make_mesh(vert, tet, capP=4 * len(vert), capT=4 * len(tet))
+        m = analyze_mesh(m).mesh
+        met = jnp.full(m.capP, 0.35, m.vert.dtype)
+        out, _, _ = grouped_adapt_pass(m, met, 3, cycles=2)
+        assert int(np.asarray(out.tmask).sum()) > 0
+
+    def grp_variants():
+        return {k: r["variants"] for k, r in ledger_snapshot().items()
+                if k.startswith("groups.")}
+
+    # save/restore the operator's knob values (bench.py does the same)
+    prev = {k: os.environ.get(k)
+            for k in ("PARMMG_GROUP_CHUNK", "PARMMG_GROUP_SCHED")}
+    os.environ["PARMMG_GROUP_CHUNK"] = "1"
+    try:
+        reset_ledger()
+        run("0")
+        v0 = grp_variants()
+        run("1")
+        v1 = grp_variants()
+    finally:
+        for k, v in prev.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    assert v0.get("groups.adapt_block", 0) >= 1, \
+        "grouped scenario no longer exercises groups.adapt_block"
+    print("--- grouped quiet-scheduler scenario")
+    if v1 != v0:
+        print("SCHEDULER COMPILE-FAMILY REGRESSIONS (scheduler on "
+              f"added variants): {v0} -> {v1}", file=sys.stderr)
+        return 1
+    bad = ledger_violations()
+    if bad:
+        print("\nLEDGER BUDGET VIOLATIONS (grouped scheduler):",
+              file=sys.stderr)
+        for v in bad:
+            print(f"  {v}", file=sys.stderr)
+        return 1
+    print(f"grouped scheduler OK: zero new compile families ({v1})")
+    return 0
+
+
 def main() -> int:
     from parmmg_tpu.utils.compilecache import (format_ledger,
                                                ledger_snapshot,
@@ -106,6 +169,9 @@ def main() -> int:
             for v in bad:
                 print(f"  {v}", file=sys.stderr)
             rc = 1
+    # quiet-group scheduler gate: compaction must reuse the compiled
+    # [chunk, ...] group program — zero new families with it enabled
+    rc = max(rc, grouped_sched_gate())
     if rc == 0:
         print("\nledger OK: all entry points within variant budgets")
     return rc

@@ -12,7 +12,9 @@ cd "$(dirname "$0")/.."
 
 # --ledger: compile-governor budget gate only — run the steady-state
 # migration scenario (G=1 AND the grouped G=2 layout, so the grouped
-# analysis/exchange entry points are budget-asserted too) and fail if
+# analysis/exchange entry points are budget-asserted too) plus the
+# chunked grouped-pass scenario asserting the quiet-group scheduler
+# introduces ZERO new compile families vs always-dispatch, and fail if
 # any registered entry point exceeded its compiled-variant budget
 # (scripts/ledger_check.py; its --diff mode compares two BENCH/SCALE
 # artifacts for variant-count regressions).

@@ -89,10 +89,24 @@ def worker() -> None:
         verbose=vb, polish=polish,
         cap_mult=float(os.environ.get("SCALE_CAPM", "3.0")))
     adapt_s = time.perf_counter() - t0
+    # quiet-group scheduler instrumentation (parallel/sched.py): the
+    # active-group trajectory, saved-dispatch counters and the chunk
+    # pipeline's upload/compute/download/writeback split ride back to
+    # the orchestrator so the SCALE artifact shows WHERE the grouped
+    # wall time goes and what the scheduler saved
+    sched_timers = {k: round(v, 3) for k, v in stats.sched_extra.items()
+                    if k.endswith("_s")}
     _save_state(outp, mesh2, met2, part_m, extra={
         "adapt_s": adapt_s, "cycles_run": stats.cycles,
         "ops": np.asarray([stats.nsplit, stats.ncollapse, stats.nswap,
                            stats.nmoved], np.int64),
+        "active_groups": np.asarray(
+            stats.sched_extra.get("active_groups_per_block", []),
+            np.int64),
+        "group_dispatches": np.asarray(stats.group_dispatches, np.int64),
+        "saved_dispatches": np.asarray(stats.group_dispatches_saved,
+                                       np.int64),
+        "sched_timers": np.asarray(json.dumps(sched_timers)),
         "device": np.asarray(jax.default_backend()),
         # this worker's compile ledger rides back to the orchestrator
         # so the BENCH artifact shows per-pass compile churn
@@ -188,11 +202,23 @@ def main():
     ops = np.zeros(4, np.int64)
     dev = "?"
     ledgers = {}
+    active_traj = {}
+    sched_timers = {}
+    group_disp = 0
+    saved_disp = 0
     for it in range(niter):
         nxt = f"{tmp}/state{it + 1}.npz"
         env = dict(os.environ)
         env.update(SCALE_IN=state, SCALE_OUT=nxt, SCALE_WORKER="1",
                    SCALE_POLISH="1" if it == niter - 1 else "0")
+        # chunked dispatch even on CPU workers (SCALE_GROUP_CHUNK,
+        # default 8): chunking is what the quiet-group scheduler
+        # compacts — on the chip it also bounds the per-dispatch HBM
+        # (group_chunk docstring), on CPU the host staging is cheap and
+        # skipping quiet groups is a straight win on this workload
+        # (SCALE_r03: op counts collapse across cycles)
+        env.setdefault("PARMMG_GROUP_CHUNK",
+                       os.environ.get("SCALE_GROUP_CHUNK", "8"))
         # the worker decides its own backend: default = real chip
         # (inherit the axon site), SCALE_DEVICE=cpu forces CPU
         if os.environ.get("SCALE_DEVICE", "") == "cpu":
@@ -223,6 +249,12 @@ def main():
         dev = str(z["device"])
         if "ledger" in z.files:
             ledgers[f"pass{it}"] = json.loads(str(z["ledger"]))
+        if "active_groups" in z.files:
+            active_traj[f"pass{it}"] = [int(v)
+                                        for v in z["active_groups"]]
+            group_disp += int(z["group_dispatches"])
+            saved_disp += int(z["saved_dispatches"])
+            sched_timers[f"pass{it}"] = json.loads(str(z["sched_timers"]))
         state = nxt
         if it + 1 < niter:
             t0 = time.perf_counter()
@@ -298,6 +330,14 @@ def main():
             "qmean": round(float(q.mean()), 4) if tm.any() else 0.0,
             "phases_s": {k: round(v, 2) for k, v in phases.items()},
             "device": dev,
+            # quiet-group scheduler (parallel/sched.py): per-pass
+            # active-group trajectory, total/saved chunk dispatches and
+            # the pipeline's upload/compute/download/writeback split —
+            # the win and the transfer/compute balance in one artifact
+            "active_groups_per_block": active_traj,
+            "group_dispatches": group_disp,
+            "saved_dispatches": saved_disp,
+            "sched_pipeline_s": sched_timers,
             # per-pass worker compile ledgers + the orchestrator's own
             # (compile governor): steady-state passes should show ~zero
             # fresh compiles once the persistent cache is warm
