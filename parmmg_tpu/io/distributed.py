@@ -163,14 +163,22 @@ def writer_tables():
 
 
 def stacked_to_distributed_files(path, stacked, comms, glo,
-                                 n_shards: int) -> list[Path]:
+                                 n_shards: int,
+                                 shards=None) -> list[Path]:
     """Write ``name.<rank>.mesh`` files DIRECTLY from the stacked shard
     state — the distributed-output/checkpoint path of the shard-resident
     loop: no ``merge_shards`` (the reference's -distributed-output never
     centralizes either, inout_pmmg.c:387).  Vertex communicators come
     from the live comm tables with local ids renumbered into each
     shard's compacted file numbering and globals from the session
-    numbering ``glo``."""
+    numbering ``glo``.
+
+    ``shards`` selects a SUBSET of slots to write, re-ranked densely
+    (slot ``shards[i]`` -> ``name.<i>.mesh``) — the multi-tenant
+    serving output path (serve/driver.py): tenants sharing one stacked
+    tree each write their own slot set to their own file set.  With
+    ``comms=None`` no communicator sections are emitted (single-slot
+    tenants have no parallel interfaces)."""
     new_id, tet_l, nvert, ntet = (np.asarray(x) for x in writer_tables()(
         stacked.vmask, stacked.tmask, stacked.tet))
     vert = np.asarray(stacked.vert)
@@ -179,23 +187,35 @@ def stacked_to_distributed_files(path, stacked, comms, glo,
     vmask = np.asarray(stacked.vmask)
     tmask = np.asarray(stacked.tmask)
     outs = []
-    for r in range(n_shards):
+    ranks = list(range(n_shards)) if shards is None \
+        else [int(s) for s in shards]
+    # subset writes are re-ranked densely, so communicator neighbor ids
+    # must follow: color_out is remapped slot->dense rank, and a
+    # neighbor OUTSIDE the subset is an error (the written file set
+    # could never resolve it) — the subset must be comm-closed
+    rankmap = {r: i for i, r in enumerate(ranks)}
+    for i, r in enumerate(ranks):
         m = MeditMesh()
         m.vert = vert[r][vmask[r]].astype(np.float64)
         m.vref = vref[r][vmask[r]]
         m.tetra = tet_l[r][tmask[r]].astype(np.int32)
         m.tref = tref[r][tmask[r]]
         node_comms = []
-        for k in range(comms.nbr.shape[1]):
+        for k in range(comms.nbr.shape[1] if comms is not None else 0):
             b = int(comms.nbr[r, k])
             if b < 0:
                 continue
+            if b not in rankmap:
+                raise ValueError(
+                    f"shard {r} has a communicator to slot {b} outside "
+                    f"the written subset {ranks}: the subset must be "
+                    "closed under its communicators")
             cnt = int(comms.node_cnt[r, k])
             rows = comms.node_idx[r, k, :cnt]
             node_comms.append(ShardComm(
-                b, new_id[r][rows].astype(np.int64) + 1,
+                rankmap[b], new_id[r][rows].astype(np.int64) + 1,
                 np.asarray(glo[r])[rows].astype(np.int64) + 1))
-        outs.append(save_distributed_mesh(path, r, m, None, node_comms))
+        outs.append(save_distributed_mesh(path, i, m, None, node_comms))
     return outs
 
 

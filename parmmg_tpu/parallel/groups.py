@@ -74,12 +74,48 @@ def group_chunk(ngroups: int) -> int:
     per chunk, same results — at the cost of one counter pull per
     chunk.  Elsewhere (CPU tests) chunking buys nothing: default 0.
     Returns 0 (unchunked) when the chunk would cover every group
-    anyway.  Override with PARMMG_GROUP_CHUNK."""
+    anyway.  Override with PARMMG_GROUP_CHUNK; PARMMG_GROUP_CHUNK=auto
+    adopts the newest trajectory-derived recommendation
+    (sched.recommend_group_chunk, recorded at the end of every grouped
+    pass) and falls back to the backend default before the first pass
+    has produced one."""
     import os
     v = os.environ.get("PARMMG_GROUP_CHUNK", "")
-    c = max(0, int(v)) if v else (
-        8 if jax.default_backend() == "tpu" else 0)
+    if v == "auto":
+        from .sched import auto_chunk_recommendation
+        rec = auto_chunk_recommendation()
+        c = rec if rec is not None else (
+            8 if jax.default_backend() == "tpu" else 0)
+    else:
+        c = max(0, int(v)) if v else (
+            8 if jax.default_backend() == "tpu" else 0)
     return 0 if c >= ngroups else c
+
+
+def block_schedule(c0: int, nblk: int, cycles: int, noswap: bool):
+    """(flags, pres) for the cycle block starting at global cycle
+    ``c0`` — THE block signature of the grouped cycle scheduler: swap
+    every 3rd cycle plus the final-two polish cycles (swap-inclusive
+    AND exact split veto via prescreen bypass — ops/split.py, ADVICE
+    r3).  Factored out so the serving pool (serve/pool.py) runs
+    byte-identical block sequences: same signature => same cached
+    compiled program (_group_block key)."""
+    flags = tuple((cc % 3 == 2 or cc >= cycles - 2) and not noswap
+                  for cc in range(c0, c0 + nblk))
+    pres = tuple(cc < cycles - 2 for cc in range(c0, c0 + nblk))
+    return flags, pres
+
+
+def block_converged(cs: np.ndarray, flags: tuple, noswap: bool) -> bool:
+    """The grouped loop's early-exit rule on a block's summed counts
+    ``cs`` [nblk, >=3]: any swap-inclusive cycle posting zero
+    split+collapse+swap ends the sizing loop.  Shared with the serving
+    pool, where it is evaluated per tenant (a tenant IS one group, so
+    the per-tenant rule equals the standalone ngroups=1 rule — the
+    serving parity contract)."""
+    return any((flags[i] or noswap) and
+               int(cs[i][0]) + int(cs[i][1]) + int(cs[i][2]) == 0
+               for i in range(len(flags)))
 
 
 # module-level compiled-block caches (compile governor): the builders
@@ -331,11 +367,7 @@ def grouped_adapt_pass(mesh: Mesh, met, ngroups: int, cycles: int = 12,
     regrows = 0
     while c < cycles:
         nblk = min(block, cycles - c)
-        # final-two polish cycles: swap-inclusive AND exact split veto
-        # (prescreen bypass — ops/split.py, ADVICE r3)
-        flags = tuple((cc % 3 == 2 or cc >= cycles - 2) and not noswap
-                      for cc in range(c, c + nblk))
-        pres = tuple(cc < cycles - 2 for cc in range(c, c + nblk))
+        flags, pres = block_schedule(c, nblk, cycles, noswap)
         step = _group_block(flags, pres, nomove, noinsert, hausd)
         swap_inc = any(flags) or noswap
         pres_all_on = all(pres)
@@ -409,9 +441,7 @@ def grouped_adapt_pass(mesh: Mesh, met, ngroups: int, cycles: int = 12,
             sched.on_regrow()
             continue        # re-run the block: truncated winners rerun
         c += nblk
-        if any((flags[i] or noswap) and
-               int(cs[i][0]) + int(cs[i][1]) + int(cs[i][2]) == 0
-               for i in range(nblk)):
+        if block_converged(cs, flags, noswap):
             break
     pol_traj: list[int] = []
     if polish and not (noinsert and noswap and nomove):
@@ -532,11 +562,23 @@ def grouped_adapt_pass(mesh: Mesh, met, ngroups: int, cycles: int = 12,
     # trajectory into AdaptStats.sched_extra (bench/SCALE artifacts),
     # the pipeline segment times into the caller's Timers (driver
     # report) under a "grp <segment>" prefix
+    # chunk auto-tune (ROADMAP 1b, lightweight): fold this pass's
+    # active-group trajectory into a chunk recommendation for the NEXT
+    # pass — adopted only under PARMMG_GROUP_CHUNK=auto, logged always
+    from .sched import note_chunk_recommendation, recommend_group_chunk
+    chunk_rec = recommend_group_chunk(sched.active_per_block,
+                                      g_exec if chunk else ngroups)
+    note_chunk_recommendation(chunk_rec)
+    if verbose >= 2:
+        print(f"  grp chunk auto-tune: recommend PARMMG_GROUP_CHUNK="
+              f"{chunk_rec or 'unchunked'} (current "
+              f"{chunk or 'unchunked'})")
     if stats is not None:
         stats.group_dispatches += sched.dispatches
         stats.group_dispatches_saved += sched.saved_dispatches
         stats.groups_skipped += sched.skipped_group_blocks
         se = stats.sched_extra
+        se.setdefault("chunk_recommendation", []).append(chunk_rec)
         se.setdefault("active_groups_per_block", []).extend(
             sched.active_per_block)
         if pol_traj:

@@ -61,6 +61,20 @@ def sched_enabled() -> bool:
     return os.environ.get("PARMMG_GROUP_SCHED", "1") != "0"
 
 
+def quiet_rows(counts: np.ndarray) -> np.ndarray:
+    """Per-row fixed-point witness from a dispatched block's counts.
+
+    ``counts``: [n, nblk, >=5].  Row ``i`` is quiet when the WHOLE
+    block was a no-op for it — zero split+collapse+swap+move AND zero
+    overflow (a truncated winner set witnesses nothing).  Shared by
+    :meth:`QuietGroupScheduler.record_block` (group granularity) and
+    the serving pool (serve/pool.py, tenant granularity): one rule, one
+    exactness argument (module docstring)."""
+    c = np.asarray(counts)
+    n = c.shape[0]
+    return c[..., :5].reshape(n, -1).sum(axis=1, dtype=np.int64) == 0
+
+
 def chunk_plans(act: np.ndarray, chunk: int) -> list:
     """Compact active group indices into dense [chunk]-sized plans.
 
@@ -162,9 +176,7 @@ class QuietGroupScheduler:
         vertex, which no later wave's priority rotation can change."""
         if not swap_inclusive or len(act) == 0:
             return
-        c = np.asarray(counts)
-        zero = c[..., :5].reshape(len(act), -1).sum(
-            axis=1, dtype=np.int64) == 0
+        zero = quiet_rows(counts)
         lvl = LEVEL_PRE if pres_all_on else LEVEL_FULL
         sel = np.asarray(act)[zero]
         self.level[sel] = np.maximum(self.level[sel], lvl)
@@ -174,3 +186,65 @@ class QuietGroupScheduler:
         scale with capT — budget-truncated winners must rerun).  Pad
         groups stay quiet (dead at any capacity)."""
         self.level[:self.ngroups] = LEVEL_ACTIVE
+
+
+# ---------------------------------------------------------------------------
+# PARMMG_GROUP_CHUNK auto-tune (ROADMAP item 1b, lightweight host side)
+# ---------------------------------------------------------------------------
+def recommend_group_chunk(traj, g_exec: int,
+                          dispatch_overhead: float = 1.0) -> int:
+    """Recommend a PARMMG_GROUP_CHUNK from a recorded
+    ``extra.active_groups_per_block`` trajectory.
+
+    Cost model per block with ``a`` active groups at chunk ``c``:
+    ``ceil(a/c) * (c + dispatch_overhead)`` in group-compute units —
+    every dispatch ships a full [c, ...] slice (short tails are padded
+    by repeating rows, which compute redundantly: chunk_plans), plus a
+    per-dispatch overhead (host gather + upload + counter sync;
+    ~one group-block of useful work on the tunneled TPU, the
+    default).  Smaller chunks track the decaying active set with less
+    padding waste; larger chunks amortize the dispatch overhead —
+    exactly the trade named in ROADMAP item 1.
+
+    Candidates are the pow2 ladder 1..g_exec (so the recommendation
+    lands on a small set of compiled [chunk, ...] shape families); ties
+    prefer the LARGER chunk (fewer dispatches at equal modeled cost).
+    Returns 0 (= unchunked) for an empty/degenerate trajectory or when
+    the winner covers every group anyway — the group_chunk() "no
+    chunking" convention."""
+    a = [int(v) for v in (traj or []) if int(v) > 0]
+    if not a or g_exec <= 1:
+        return 0
+    cands = []
+    c = 1
+    while c < g_exec:
+        cands.append(c)
+        c *= 2
+    cands.append(g_exec)
+
+    def cost(c: int) -> float:
+        return sum(-(-ab // c) * (c + dispatch_overhead) for ab in a)
+
+    best = max((c for c in cands
+                if cost(c) == min(cost(x) for x in cands)))
+    return 0 if best >= g_exec else best
+
+
+# last recommendation computed by a grouped pass in this process
+# (module-level on purpose: the steady-state loop re-enters
+# grouped_adapt_pass every outer iteration, and PARMMG_GROUP_CHUNK=auto
+# reads the newest trajectory-derived value at the NEXT pass — no
+# behavior change unless the operator opts in with "auto").  Only the
+# newest value is kept: a long-lived serving process notes one per
+# pass forever, and only [-1] is ever read.
+_CHUNK_RECOMMENDATION: list[int] = []
+
+
+def note_chunk_recommendation(chunk: int) -> None:
+    _CHUNK_RECOMMENDATION[:] = [int(chunk)]
+
+
+def auto_chunk_recommendation() -> int | None:
+    """Newest recorded recommendation, or None before any grouped pass
+    has run (group_chunk then falls back to the backend default)."""
+    return _CHUNK_RECOMMENDATION[-1] if _CHUNK_RECOMMENDATION else None

@@ -1,0 +1,26 @@
+"""Remeshing-as-a-service: multi-tenant mesh serving on the group axis.
+
+The groups x shards machinery treats G logical meshes per device
+uniformly — nothing requires them to be slices of ONE mesh.  This
+package is the persistent serving mode built on that observation
+(ROADMAP open item 3): N independent tenant meshes ride the bucketed
+``[G, ...]`` capacity ladders through the SAME compiled group programs
+the batch path runs, so a warm pool serves every request with ZERO
+fresh XLA compiles.
+
+- :mod:`pool` — slot pool + admission: bucketed group slots (capacity
+  ladders from ``utils.compilecache.bucket``), smallest-fitting-bucket
+  admission, chunk-compacted dispatch through
+  ``parallel.groups._group_block``, per-tenant convergence and slot
+  recycling;
+- :mod:`driver` — request lifecycle: a submit/poll/fetch API over a
+  work queue (medit/VTK in, merge-free distributed checkpoints out),
+  per-request AdaptStats + qmin/qmean quality SLO, admission /
+  rejection / timeout / max-in-flight knobs (``PARMMG_SERVE_*``).
+
+Front-ends: ``scripts/serve_run.py`` (file-based CLI) and
+``scripts/serve_bench.py`` (the SERVE_r* artifact: meshes/sec,
+latency percentiles, occupancy, ledger diff vs the batch path).
+"""
+from .pool import SlotPool                         # noqa: F401
+from .driver import ServeDriver, ServeRequest      # noqa: F401

@@ -1,0 +1,347 @@
+"""Request lifecycle + queue driver for the serving pool.
+
+A minimal submit/poll/fetch front-end over :class:`serve.pool.SlotPool`
+— the "simple queue/driver front-end" of ROADMAP open item 3:
+
+- **submit** a tenant mesh (in-memory arrays, or a medit ``.mesh[b]`` /
+  VTK ``.vtu`` file streamed through io.medit / io.vtk, with an
+  optional ``.sol`` metric) -> request id;
+- the **run loop** admits queued requests into the smallest fitting
+  bucket (FIFO, bounded by PARMMG_SERVE_MAX_INFLIGHT), steps the pool,
+  and retires converged tenants: per-request ``AdaptStats``
+  (tenant-tagged — ops.adapt.AdaptStats refuses cross-tenant merges)
+  and the qmin/qmean quality SLO are computed on retirement, the slot
+  is recycled for the next queued request;
+- **poll** returns the request state machine position
+  (queued / running / done / rejected / failed / timeout);
+- **fetch** returns the merged (Mesh, met); ``write_distributed``
+  emits the merge-free per-tenant checkpoint straight from the slot
+  state (io.distributed.stacked_to_distributed_files with a slot
+  subset — the -distributed-output contract, no centralization).
+
+Knobs (env, constructor args win): PARMMG_SERVE_MAX_INFLIGHT (0 =
+unbounded), PARMMG_SERVE_TIMEOUT_S (wall-clock per request, 0 = off),
+plus the pool's PARMMG_SERVE_SLOTS / _CHUNK / _MAX_CAPP / _MAX_CAPT.
+"""
+from __future__ import annotations
+
+import dataclasses
+import time
+
+import numpy as np
+
+from .pool import SlotPool, _env_int
+
+QUEUED = "queued"
+RUNNING = "running"
+DONE = "done"
+REJECTED = "rejected"
+FAILED = "failed"
+TIMEOUT = "timeout"
+
+
+@dataclasses.dataclass
+class ServeRequest:
+    """One tenant request riding the pool."""
+    tid: str
+    mesh: object = None          # staged core Mesh (host/device)
+    met: object = None
+    path: str | None = None      # input file (medit/.vtu), lazy-staged
+    sol: str | None = None
+    state: str = QUEUED
+    reason: str = ""
+    t_submit: float = 0.0
+    t_admit: float = 0.0
+    t_done: float = 0.0
+    quality: dict | None = None  # {"qmin", "qmean", "ntets"} SLO fields
+    stats: object = None         # tenant-tagged AdaptStats
+    out_files: list = dataclasses.field(default_factory=list)
+
+    @property
+    def latency_s(self) -> float:
+        return max(0.0, self.t_done - self.t_submit)
+
+
+def _stage_file(path: str, sol: str | None):
+    """File -> (core Mesh, met): medit or VTK in, analysis tags on,
+    metric from the .sol (scalar/tensor) or the -optim default."""
+    import jax.numpy as jnp
+    from ..core.mesh import make_mesh
+    from ..io.medit import read_mesh, read_sol
+    from ..ops.analysis import analyze_mesh
+    from ..ops.metric import metric_optim
+
+    vtu_met = None
+    if str(path).endswith(".vtu"):
+        from ..io.vtk import read_vtu_medit
+        mm, vtu_met, _fields = read_vtu_medit(path)
+    else:
+        mm = read_mesh(path)
+    mesh = make_mesh(mm.vert, mm.tetra, vref=mm.vref, tref=mm.tref)
+    mesh = analyze_mesh(mesh).mesh
+    vals = None
+    if sol:
+        vals, _types = read_sol(sol)
+    elif vtu_met is not None:
+        vals = np.asarray(vtu_met)
+    if vals is not None:
+        vals = np.asarray(vals)
+        met = np.ones((mesh.capP,) + vals.shape[1:], np.float64)
+        met[: len(vals)] = vals
+        if met.ndim == 2 and met.shape[1] == 1:
+            met = met[:, 0]
+        met = jnp.asarray(met, mesh.vert.dtype)
+    else:
+        met = metric_optim(mesh)
+    return mesh, met
+
+
+class ServeDriver:
+    """FIFO queue + admission + retirement around a SlotPool."""
+
+    def __init__(self, pool: SlotPool | None = None,
+                 out_dir: str | None = None,
+                 max_inflight: int | None = None,
+                 timeout_s: float | None = None,
+                 verbose: int = 0, **pool_kwargs):
+        self.pool = pool if pool is not None else SlotPool(**pool_kwargs)
+        self.out_dir = out_dir
+        self.max_inflight = max_inflight if max_inflight is not None \
+            else _env_int("PARMMG_SERVE_MAX_INFLIGHT", 0)
+        if timeout_s is None:
+            import os
+            timeout_s = float(os.environ.get("PARMMG_SERVE_TIMEOUT_S",
+                                             "0") or 0)
+        self.timeout_s = float(timeout_s)
+        self.verbose = verbose
+        self.requests: dict[str, ServeRequest] = {}
+        self.queue: list[str] = []
+        self._seq = 0
+
+    # ---- API --------------------------------------------------------------
+    def submit(self, mesh=None, met=None, path=None, sol=None,
+               tenant: str | None = None) -> str:
+        """Enqueue a request; returns the request/tenant id."""
+        if tenant is None:
+            tenant = f"t{self._seq:04d}"
+        self._seq += 1
+        if tenant in self.requests:
+            raise ValueError(f"duplicate tenant id {tenant!r}")
+        req = ServeRequest(tid=tenant, mesh=mesh, met=met, path=path,
+                           sol=sol, t_submit=time.perf_counter())
+        self.requests[tenant] = req
+        self.queue.append(tenant)
+        return tenant
+
+    def poll(self, tid: str) -> dict:
+        r = self.requests[tid]
+        out = {"tid": tid, "state": r.state, "reason": r.reason}
+        if r.state == DONE:
+            out["latency_s"] = round(r.latency_s, 3)
+            out["quality"] = r.quality
+        return out
+
+    def fetch(self, tid: str):
+        """Merged (Mesh, met) of a DONE request (merge-free file output
+        goes through write_distributed / out_dir instead)."""
+        r = self.requests[tid]
+        if r.state != DONE:
+            raise RuntimeError(f"request {tid} is {r.state}, not done")
+        return r.mesh, r.met
+
+    def write_distributed(self, tid: str, path) -> list:
+        """Merge-free checkpoint of a tenant's slot straight from the
+        pool's stacked state (the reference's -distributed-output never
+        centralizes either)."""
+        from ..io.distributed import stacked_to_distributed_files
+        b, i = self.pool.slot_state(tid)
+        return stacked_to_distributed_files(
+            path, b.stacked, None, None, b.nslots, shards=[i])
+
+    # ---- the serving loop --------------------------------------------------
+    def _admit_from_queue(self) -> None:
+        inflight = len(self.pool.active_tenants())
+        remaining = []
+        for tid in self.queue:
+            r = self.requests[tid]
+            if self.max_inflight and inflight >= self.max_inflight:
+                remaining.append(tid)
+                continue
+            try:
+                if r.mesh is None and r.path is not None:
+                    r.mesh, r.met = _stage_file(r.path, r.sol)
+                # needP counts TET-REFERENCED vertices, exactly what
+                # split_to_shards sizes capP from — an orphan vertex
+                # must not inflate the admission bucket past the rung
+                # the split will actually produce
+                tm = np.asarray(r.mesh.tmask)
+                nt = int(tm.sum())
+                nv = len(np.unique(np.asarray(r.mesh.tet)[tm]))
+                mw = 0 if np.asarray(r.met).ndim == 1 \
+                    else int(np.asarray(r.met).shape[-1])
+            except Exception as e:
+                # per-request fault isolation: a corrupt input must not
+                # take down the loop or the other tenants
+                r.state = FAILED
+                r.reason = f"staging failed: {e}"
+                r.t_done = time.perf_counter()
+                continue
+            got = self.pool.admit(tid, nv, nt, met_width=mw)
+            if got[0] == "oversize":
+                r.state = REJECTED
+                r.reason = (f"needs caps {got[1][0]}x{got[1][1]} > pool "
+                            f"max {self.pool.max_capP}x"
+                            f"{self.pool.max_capT}")
+                r.t_done = time.perf_counter()
+                continue
+            if got[0] == "full":
+                remaining.append(tid)       # waits for a recycled slot
+                continue
+            try:
+                self.pool.load(tid, r.mesh, r.met)
+            except Exception as e:
+                self.pool.release(tid)      # fault isolation (as above)
+                r.state = FAILED
+                r.reason = f"load failed: {e}"
+                r.t_done = time.perf_counter()
+                continue
+            r.state = RUNNING
+            r.t_admit = time.perf_counter()
+            inflight += 1
+            if self.verbose:
+                # stderr: stdout belongs to the front-ends' JSON report
+                import sys
+                print(f"serve: admitted {tid} -> bucket "
+                      f"{got[1][0]}x{got[1][1]} slot {got[2]}",
+                      file=sys.stderr)
+        self.queue = remaining
+
+    def _retire(self, tid: str) -> None:
+        from ..ops.quality import quality_histogram, tet_quality
+        r = self.requests[tid]
+        slot = self.pool.slot_of(tid)
+        r.stats = slot.stats
+        if slot.failed:
+            r.state = FAILED
+            r.reason = slot.failed
+        else:
+            if self.out_dir is not None:
+                from pathlib import Path
+                out = Path(self.out_dir) / f"{tid}.mesh"
+                r.out_files = [str(p) for p in
+                               self.write_distributed(tid, out)]
+            mesh, met = self.pool.merge(tid)
+            r.mesh, r.met = mesh, met
+            q = tet_quality(mesh, met)
+            _, qmin, qmean, nbad = quality_histogram(q, mesh.tmask)
+            r.quality = {"qmin": round(float(qmin), 6),
+                         "qmean": round(float(qmean), 6),
+                         "nbad": int(nbad),
+                         "ntets": int(np.asarray(mesh.tmask).sum())}
+            r.state = DONE
+        r.t_done = time.perf_counter()
+        self.pool.release(tid)
+        if self.verbose:
+            import sys
+            print(f"serve: retired {tid} ({r.state}"
+                  + (f", qmin {r.quality['qmin']}" if r.quality else "")
+                  + f", {r.latency_s:.2f}s)", file=sys.stderr)
+
+    def _expire_timeouts(self) -> None:
+        if not self.timeout_s:
+            return
+        now = time.perf_counter()
+        for tid, r in self.requests.items():
+            if r.state == RUNNING and now - r.t_submit > self.timeout_s:
+                slot = self.pool.slot_of(tid)
+                r.stats = slot.stats
+                r.state = TIMEOUT
+                r.reason = f"exceeded {self.timeout_s}s"
+                r.t_done = now
+                self.pool.release(tid)
+            elif r.state == QUEUED and now - r.t_submit > self.timeout_s:
+                r.state = TIMEOUT
+                r.reason = f"queued past {self.timeout_s}s"
+                r.t_done = now
+                self.queue = [t for t in self.queue if t != tid]
+
+    def run(self, max_steps: int = 10000) -> dict:
+        """Drive the loop until every request reaches a terminal state.
+        Returns the serving report (per-tenant + pool aggregates)."""
+        occupancy_traj = []
+        for _ in range(max_steps):
+            self._expire_timeouts()
+            self._admit_from_queue()
+            if not self.pool.active_tenants():
+                if self.queue:
+                    # queued work but nothing admitted: deadlocked on
+                    # capacity (e.g. max_inflight 0 slots) — bail out
+                    # rather than spin
+                    for tid in self.queue:
+                        r = self.requests[tid]
+                        r.state = REJECTED
+                        r.reason = "pool cannot admit (no slot ever)"
+                        r.t_done = time.perf_counter()
+                    self.queue = []
+                break
+            occupancy_traj.append(self.pool.occupancy())
+            for tid in self.pool.step(verbose=self.verbose):
+                self._retire(tid)
+        return self.report(occupancy_traj)
+
+    # ---- reporting ----------------------------------------------------------
+    def report(self, occupancy_traj=None) -> dict:
+        from ..ops.adapt import AdaptStats
+        agg = AdaptStats()
+        tenants = {}
+        for tid, r in sorted(self.requests.items()):
+            if r.stats is not None:
+                agg += r.stats          # namespaced per tenant
+            tenants[tid] = {
+                "state": r.state,
+                "reason": r.reason,
+                "latency_s": round(r.latency_s, 3),
+                "quality": r.quality,
+                "cycles": r.stats.cycles if r.stats else 0,
+                "ops": ([r.stats.nsplit, r.stats.ncollapse,
+                         r.stats.nswap, r.stats.nmoved]
+                        if r.stats else [0, 0, 0, 0]),
+                "out_files": r.out_files,
+            }
+        lat = sorted(t["latency_s"] for t in tenants.values()
+                     if t["state"] == DONE)
+
+        def pct(p):
+            # nearest-rank percentile, integer ceil: rank(p) =
+            # ceil(p*n) (int(p*n) would hand p90-of-10 the maximum;
+            # float ceil mis-rounds 0.9*10)
+            if not lat:
+                return 0.0
+            rank = (int(p * 100) * len(lat) + 99) // 100
+            return round(lat[min(len(lat), max(rank, 1)) - 1], 3)
+
+        return {
+            "tenants": tenants,
+            "served": sum(1 for t in tenants.values()
+                          if t["state"] == DONE),
+            "rejected": sum(1 for t in tenants.values()
+                            if t["state"] == REJECTED),
+            "failed": sum(1 for t in tenants.values()
+                          if t["state"] in (FAILED, TIMEOUT)),
+            "latency_p50_s": pct(0.50),
+            "latency_p90_s": pct(0.90),
+            "latency_max_s": lat[-1] if lat else 0.0,
+            "pool": {
+                "steps": self.pool.steps,
+                "dispatches": self.pool.dispatches,
+                "chunk": self.pool.chunk,
+                "slots_per_bucket": self.pool.slots_per_bucket,
+                "buckets": self.pool.occupancy(),
+                "active_per_step": list(self.pool.active_per_step),
+                "chunk_recommendation": self.pool.chunk_recommendation(),
+                "pipeline_s": {k: round(v, 3)
+                               for k, v in self.pool.timers.acc.items()},
+            },
+            "occupancy_traj": occupancy_traj or [],
+            "agg_sched_extra": {k: v for k, v in agg.sched_extra.items()},
+        }

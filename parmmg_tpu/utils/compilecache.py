@@ -363,6 +363,16 @@ def ledger_snapshot() -> dict:
     return LEDGER.snapshot()
 
 
+def variants_by_prefix(prefix: str) -> dict:
+    """{entry: compiled-variant count} for ledger entries under a name
+    prefix — the compile-family comparison unit of the zero-new-family
+    gates (ledger_check grouped_sched_gate / serving_gate) and of
+    scripts/serve_bench.py's batch-vs-serve diff: snapshot before,
+    snapshot after, equality == no new compiled shape families."""
+    return {k: r["variants"] for k, r in LEDGER.snapshot().items()
+            if k.startswith(prefix)}
+
+
 def format_ledger(min_compiles: int = 0) -> str:
     return LEDGER.format(min_compiles)
 

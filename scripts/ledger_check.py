@@ -83,9 +83,9 @@ def grouped_sched_gate() -> int:
     from parmmg_tpu.core.mesh import make_mesh
     from parmmg_tpu.ops.analysis import analyze_mesh
     from parmmg_tpu.parallel.groups import grouped_adapt_pass
-    from parmmg_tpu.utils.compilecache import (ledger_snapshot,
-                                               ledger_violations,
-                                               reset_ledger)
+    from parmmg_tpu.utils.compilecache import (ledger_violations,
+                                               reset_ledger,
+                                               variants_by_prefix)
     from parmmg_tpu.utils.fixtures import cube_mesh
 
     def run(sched: str):
@@ -98,8 +98,7 @@ def grouped_sched_gate() -> int:
         assert int(np.asarray(out.tmask).sum()) > 0
 
     def grp_variants():
-        return {k: r["variants"] for k, r in ledger_snapshot().items()
-                if k.startswith("groups.")}
+        return variants_by_prefix("groups.")
 
     # save/restore the operator's knob values (bench.py does the same)
     prev = {k: os.environ.get(k)
@@ -132,6 +131,89 @@ def grouped_sched_gate() -> int:
             print(f"  {v}", file=sys.stderr)
         return 1
     print(f"grouped scheduler OK: zero new compile families ({v1})")
+    return 0
+
+
+def serving_gate() -> int:
+    """Serving compile-family gate: a warm pool serving tenants of two
+    DIFFERENT bucket sizes must add ZERO ``groups.*`` compile-ledger
+    families versus the batch grouped path run in the same process —
+    the pool's slots are shape-identical to the standalone
+    ``grouped_adapt_pass(ngroups=1)`` layout (same capacity-ladder
+    rungs, same cached ``_group_block`` programs), so serving is
+    compile-free after the per-bucket warmup any batch user pays.
+    Doubles as a bit-for-bit parity check: each tenant's merged output
+    must equal its standalone run (mesh fields + metric)."""
+    import jax.numpy as jnp
+    from parmmg_tpu.core.mesh import MESH_FIELDS, make_mesh
+    from parmmg_tpu.ops.analysis import analyze_mesh
+    from parmmg_tpu.parallel.groups import grouped_adapt_pass
+    from parmmg_tpu.serve.driver import ServeDriver
+    from parmmg_tpu.utils.compilecache import (ledger_violations,
+                                               reset_ledger,
+                                               variants_by_prefix)
+    from parmmg_tpu.utils.fixtures import cube_mesh
+
+    cycles = 2
+
+    def tenant(n, h):
+        vert, tet = cube_mesh(n)
+        m = make_mesh(vert, tet, capP=4 * len(vert), capT=4 * len(tet))
+        m = analyze_mesh(m).mesh
+        met = jnp.full(m.capP, h, m.vert.dtype)
+        return m, met
+
+    def grp_variants():
+        return variants_by_prefix("groups.")
+
+    reset_ledger()
+    classes = ((2, 0.55), (3, 0.5))
+    # batch warmup: the standalone grouped path per bucket size — this
+    # is the only phase allowed to compile groups.* programs
+    refs = {}
+    for n, h in classes:
+        m, met = tenant(n, h)
+        out, met_m, _ = grouped_adapt_pass(m, met, 1, cycles=cycles)
+        refs[n] = (out, met_m)
+    v0 = grp_variants()
+    assert v0.get("groups.adapt_block", 0) >= 1, \
+        "serving warmup no longer exercises groups.adapt_block"
+    drv = ServeDriver(slots_per_bucket=2, chunk=1, cycles=cycles)
+    for n, h in classes:
+        m, met = tenant(n, h)
+        drv.submit(mesh=m, met=met, tenant=f"n{n}")
+    rep = drv.run()
+    v1 = grp_variants()
+    print("--- serving scenario (2 tenants, 2 buckets, warm pool)")
+    if rep["served"] != 2:
+        print(f"SERVING GATE: expected 2 served tenants, got {rep}",
+              file=sys.stderr)
+        return 1
+    if v1 != v0:
+        print("SERVING COMPILE-FAMILY REGRESSIONS (warm pool added "
+              f"variants): {v0} -> {v1}", file=sys.stderr)
+        return 1
+    for n, _h in classes:
+        mesh, met_m = drv.fetch(f"n{n}")
+        ref, kref = refs[n]
+        for f in MESH_FIELDS:
+            if not (np.asarray(getattr(mesh, f))
+                    == np.asarray(getattr(ref, f))).all():
+                print(f"SERVING PARITY: tenant n{n} field {f} differs "
+                      "from the standalone grouped run", file=sys.stderr)
+                return 1
+        if not (np.asarray(met_m) == np.asarray(kref)).all():
+            print(f"SERVING PARITY: tenant n{n} metric differs",
+                  file=sys.stderr)
+            return 1
+    bad = ledger_violations()
+    if bad:
+        print("\nLEDGER BUDGET VIOLATIONS (serving):", file=sys.stderr)
+        for v in bad:
+            print(f"  {v}", file=sys.stderr)
+        return 1
+    print(f"serving OK: zero new compile families ({v1}), "
+          "bit-for-bit parity with the batch grouped path")
     return 0
 
 
@@ -172,6 +254,9 @@ def main() -> int:
     # quiet-group scheduler gate: compaction must reuse the compiled
     # [chunk, ...] group program — zero new families with it enabled
     rc = max(rc, grouped_sched_gate())
+    # serving gate: a warm multi-tenant pool adds zero groups.*
+    # families vs the batch grouped path (and matches it bit-for-bit)
+    rc = max(rc, serving_gate())
     if rc == 0:
         print("\nledger OK: all entry points within variant budgets")
     return rc

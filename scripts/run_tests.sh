@@ -12,10 +12,13 @@ cd "$(dirname "$0")/.."
 
 # --ledger: compile-governor budget gate only — run the steady-state
 # migration scenario (G=1 AND the grouped G=2 layout, so the grouped
-# analysis/exchange entry points are budget-asserted too) plus the
-# chunked grouped-pass scenario asserting the quiet-group scheduler
-# introduces ZERO new compile families vs always-dispatch, and fail if
-# any registered entry point exceeded its compiled-variant budget
+# analysis/exchange entry points are budget-asserted too), the chunked
+# grouped-pass scenario asserting the quiet-group scheduler introduces
+# ZERO new compile families vs always-dispatch, and the serving_gate
+# (a warm multi-tenant pool serving 2 tenants of different bucket
+# sizes adds zero groups.* families vs the batch grouped path in the
+# same process, bit-for-bit parity included); fail if any registered
+# entry point exceeded its compiled-variant budget
 # (scripts/ledger_check.py; its --diff mode compares two BENCH/SCALE
 # artifacts for variant-count regressions).
 if [ "${1:-}" = "--ledger" ]; then

@@ -103,6 +103,11 @@ def worker() -> None:
         "active_groups": np.asarray(
             stats.sched_extra.get("active_groups_per_block", []),
             np.int64),
+        # chunk auto-tune (sched.recommend_group_chunk, logged by the
+        # grouped pass): adopted only under PARMMG_GROUP_CHUNK=auto
+        "chunk_recommendation": np.asarray(
+            stats.sched_extra.get("chunk_recommendation", [0])[-1],
+            np.int64),
         "group_dispatches": np.asarray(stats.group_dispatches, np.int64),
         "saved_dispatches": np.asarray(stats.group_dispatches_saved,
                                        np.int64),
@@ -206,6 +211,7 @@ def main():
     sched_timers = {}
     group_disp = 0
     saved_disp = 0
+    chunk_rec = 0
     for it in range(niter):
         nxt = f"{tmp}/state{it + 1}.npz"
         env = dict(os.environ)
@@ -255,6 +261,11 @@ def main():
             group_disp += int(z["group_dispatches"])
             saved_disp += int(z["saved_dispatches"])
             sched_timers[f"pass{it}"] = json.loads(str(z["sched_timers"]))
+        if "chunk_recommendation" in z.files:
+            chunk_rec = int(z["chunk_recommendation"])
+            print(f"scale: pass {it} recommends PARMMG_GROUP_CHUNK="
+                  f"{chunk_rec or 'unchunked'} (auto-tune; set "
+                  "PARMMG_GROUP_CHUNK=auto to adopt)", file=sys.stderr)
         state = nxt
         if it + 1 < niter:
             t0 = time.perf_counter()
@@ -337,6 +348,7 @@ def main():
             "active_groups_per_block": active_traj,
             "group_dispatches": group_disp,
             "saved_dispatches": saved_disp,
+            "chunk_recommendation": chunk_rec,
             "sched_pipeline_s": sched_timers,
             # per-pass worker compile ledgers + the orchestrator's own
             # (compile governor): steady-state passes should show ~zero

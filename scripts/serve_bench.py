@@ -1,0 +1,217 @@
+"""Serving bench: N mixed-size tenants through one warm pool.
+
+The first artifact family whose throughput metric is **meshes/sec**,
+not Mtets/sec (ROADMAP open item 3): a pool of bucketed group slots
+serves independent tenant meshes through the SAME compiled group
+programs the batch grouped path uses, so after a per-bucket warmup
+every request runs compile-free.
+
+Phases:
+
+1. **warmup** — per tenant size class, one standalone
+   ``grouped_adapt_pass(ngroups=1)`` run (the batch path: exactly what
+   a non-serving user pays) + the quality pull.  This compiles every
+   ``groups.*`` family serving will touch AND doubles as the parity
+   reference;
+2. **serve** — submit all tenants to one ServeDriver, run to
+   completion, measure meshes/sec + per-tenant latency percentiles +
+   slot occupancy;
+3. **gates** — ``extra.ledger_regressions`` lists any ``groups.*``
+   entry whose compiled-variant count grew between (1) and (2) (MUST
+   be empty: serving adds zero compile families after warmup), and
+   ``extra.parity_ok`` asserts one representative tenant per class is
+   bit-for-bit identical (mesh fields + metric) to its standalone run.
+
+Prints ONE JSON line (bench.py shape) and writes it to SERVE_r<NN>.json
+(next free round number; SERVE_OUT overrides).  Knobs: SERVE_TENANTS
+(default 8), SERVE_CYCLES (default 3), SERVE_SLOTS (slots/bucket,
+default 2 so slot recycling is exercised), SERVE_CHUNK (default 1).
+"""
+from __future__ import annotations
+
+import glob
+import json
+import os
+import re
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+# CPU backend, axon factory dropped (ledger_check.py sequence): the
+# serving datapoint is a CPU-backend artifact until a chip session
+# validates the tunnel path
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.pop("JAX_COMPILATION_CACHE_DIR", None)   # cold = honest warmup
+
+import jax  # noqa: E402
+
+try:
+    from jax._src import xla_bridge as _xb
+    _xb._backend_factories.pop("axon", None)
+except Exception:
+    pass
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+
+def _tenant(n: int, h: float):
+    import jax.numpy as jnp
+    from parmmg_tpu.core.mesh import make_mesh
+    from parmmg_tpu.ops.analysis import analyze_mesh
+    from parmmg_tpu.utils.fixtures import analytic_iso_metric, cube_mesh
+
+    vert, tet = cube_mesh(n)
+    m = make_mesh(vert, tet, capP=4 * len(vert), capT=4 * len(tet))
+    m = analyze_mesh(m).mesh
+    hh = analytic_iso_metric(vert, "shock", h=h)
+    met = jnp.zeros(m.capP, m.vert.dtype).at[: len(hh)].set(
+        jnp.asarray(hh, m.vert.dtype)).at[len(hh):].set(1.0)
+    return m, met
+
+
+def main() -> int:
+    from parmmg_tpu.core.mesh import MESH_FIELDS
+    from parmmg_tpu.ops.quality import quality_histogram, tet_quality
+    from parmmg_tpu.parallel.groups import grouped_adapt_pass
+    from parmmg_tpu.serve.driver import ServeDriver
+    from parmmg_tpu.utils.compilecache import (
+        ledger_snapshot, regressions_vs_latest_artifact,
+        variants_by_prefix)
+
+    ntenants = int(os.environ.get("SERVE_TENANTS", "8"))
+    cycles = int(os.environ.get("SERVE_CYCLES", "3"))
+    slots = int(os.environ.get("SERVE_SLOTS", "2"))
+    chunk = int(os.environ.get("SERVE_CHUNK", "1"))
+
+    # three size classes -> three distinct capacity-ladder buckets
+    classes = [("small", 2, 0.55), ("medium", 3, 0.45),
+               ("large", 4, 0.60)]
+
+    # ---- phase 1: batch warmup (+ parity reference) ----------------------
+    warm = {}
+    warm_s = {}
+    for name, n, h in classes:
+        m, met = _tenant(n, h)
+        t0 = time.perf_counter()
+        out, met_m, _ = grouped_adapt_pass(m, met, 1, cycles=cycles)
+        jax.block_until_ready(out.vert)
+        warm_s[name] = round(time.perf_counter() - t0, 2)
+        q = np.asarray(tet_quality(out, met_m))[np.asarray(out.tmask)]
+        warm[name] = (out, met_m, float(q.min()), float(q.mean()))
+        print(f"serve_bench: warmup {name} (cube {n}, h={h}): "
+              f"{warm_s[name]}s batch", file=sys.stderr)
+
+    def grp_variants():
+        return variants_by_prefix("groups.")
+
+    v_batch = grp_variants()
+
+    # ---- phase 2: serve N tenants through one warm pool ------------------
+    drv = ServeDriver(slots_per_bucket=slots, chunk=chunk, cycles=cycles,
+                      verbose=1)
+    tenants = []
+    for i in range(ntenants):
+        name, n, h = classes[i % len(classes)]
+        m, met = _tenant(n, h)
+        tid = drv.submit(mesh=m, met=met, tenant=f"{name}{i:02d}")
+        tenants.append((tid, name))
+    t0 = time.perf_counter()
+    rep = drv.run()
+    serve_s = time.perf_counter() - t0
+
+    v_serve = grp_variants()
+    regressions = [f"{k}: {v_batch.get(k, 0)} -> {v}"
+                   for k, v in sorted(v_serve.items())
+                   if v > v_batch.get(k, 0)]
+
+    # ---- phase 3: parity — one tenant per class vs its standalone run ----
+    parity_ok = True
+    seen = set()
+    for tid, name in tenants:
+        if name in seen:
+            continue
+        seen.add(name)
+        mesh, met_m = drv.fetch(tid)
+        ref, kref = warm[name][0], warm[name][1]
+        for f in MESH_FIELDS:
+            if not (np.asarray(getattr(mesh, f))
+                    == np.asarray(getattr(ref, f))).all():
+                parity_ok = False
+                print(f"serve_bench: PARITY MISMATCH {tid} field {f}",
+                      file=sys.stderr)
+        if not (np.asarray(met_m) == np.asarray(kref)).all():
+            parity_ok = False
+            print(f"serve_bench: PARITY MISMATCH {tid} metric",
+                  file=sys.stderr)
+
+    root = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..")
+    ledger = ledger_snapshot()
+    cross = regressions_vs_latest_artifact(root, "SERVE_r*.json", ledger)
+
+    per_tenant = {
+        tid: {
+            "class": name,
+            "state": rep["tenants"][tid]["state"],
+            "latency_s": rep["tenants"][tid]["latency_s"],
+            "qmin": (rep["tenants"][tid]["quality"] or {}).get("qmin"),
+            "qmean": (rep["tenants"][tid]["quality"] or {}).get("qmean"),
+            "ntets": (rep["tenants"][tid]["quality"] or {}).get("ntets"),
+            "ops": rep["tenants"][tid]["ops"],
+        } for tid, name in tenants}
+
+    doc = {
+        "metric": "serve_throughput",
+        "value": round(rep["served"] / max(serve_s, 1e-9), 3),
+        "unit": "meshes/sec (warm pool, CPU backend)",
+        "extra": {
+            "tenants": ntenants,
+            "served": rep["served"],
+            "rejected": rep["rejected"],
+            "failed": rep["failed"],
+            "bucket_sizes": sorted({f"{k[0]}x{k[1]}" for k in
+                                    drv.pool.buckets}),
+            "cycles": cycles,
+            "chunk": chunk,
+            "slots_per_bucket": slots,
+            "serve_wall_s": round(serve_s, 3),
+            "warmup_batch_s": warm_s,
+            "latency_p50_s": rep["latency_p50_s"],
+            "latency_p90_s": rep["latency_p90_s"],
+            "latency_max_s": rep["latency_max_s"],
+            "per_tenant": per_tenant,
+            "slot_occupancy": rep["occupancy_traj"],
+            "active_per_step": rep["pool"]["active_per_step"],
+            "dispatches": rep["pool"]["dispatches"],
+            "chunk_recommendation": rep["pool"]["chunk_recommendation"],
+            "pipeline_s": rep["pool"]["pipeline_s"],
+            "parity_ok": parity_ok,
+            "groups_variants_batch": v_batch,
+            "groups_variants_serve": v_serve,
+            "ledger_regressions": regressions,
+            "ledger_regressions_vs_artifact": cross,
+            "compile_ledger": ledger,
+            "device": jax.default_backend(),
+        },
+    }
+    line = json.dumps(doc)
+    print(line)
+
+    out = os.environ.get("SERVE_OUT")
+    if not out:
+        nums = [int(m.group(1)) for p in glob.glob(
+            os.path.join(root, "SERVE_r*.json"))
+            if (m := re.search(r"r(\d+)\.json$", p))]
+        out = os.path.join(root, f"SERVE_r{max(nums, default=0) + 1:02d}"
+                                 ".json")
+    with open(out, "w") as f:
+        f.write(line + "\n")
+    print(f"serve_bench: wrote {out}", file=sys.stderr)
+    if regressions or not parity_ok:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -147,6 +147,51 @@ def test_stacked_writer_roundtrip(tmp_path):
     assert m0.global_.tolist() == m1.global_.tolist() == [1, 2, 3]
 
 
+def test_stacked_writer_multi_tenant_roundtrip(tmp_path):
+    """Serving satellite: two TENANTS sharing one stacked tree write to
+    separate per-tenant file sets (``shards`` slot subset, no
+    communicators) and read back bit-identical.  The hand-built state
+    reuses the exact stacked shapes of test_stacked_writer_roundtrip so
+    tier-1 pays zero fresh writer_tables compiles (host-side numpy
+    otherwise)."""
+    import jax
+    import jax.numpy as jnp
+    from parmmg_tpu.core.mesh import make_mesh
+    from parmmg_tpu.io.distributed import stacked_to_distributed_files
+
+    # two independent tenant meshes as slots of ONE stacked tree —
+    # same [2, 6]/[2, 2] capacities as the checkpoint test above
+    vA = np.array([[0, 0, 0], [2, 0, 0], [0, 2, 0], [0, 0, 2]], float)
+    vB = np.array([[0, 0, 0], [1, 0, 0], [0, 1, 0], [0, 0, -3],
+                   [1, 1, 1]], float)
+    mA = make_mesh(vA, np.asarray([[0, 1, 2, 3]], np.int32),
+                   vref=np.asarray([1, 2, 3, 4], np.int32),
+                   capP=6, capT=2)
+    mB = make_mesh(vB, np.asarray([[0, 2, 1, 3], [0, 1, 2, 4]],
+                                  np.int32),
+                   tref=np.asarray([7, 8], np.int32), capP=6, capT=2)
+    stacked = jax.tree.map(lambda a, b: jnp.stack([a, b]), mA, mB)
+
+    outs = {}
+    for tid, slot in (("tenantA", 0), ("tenantB", 1)):
+        got = stacked_to_distributed_files(
+            tmp_path / f"{tid}.mesh", stacked, None, None, 2,
+            shards=[slot])
+        assert [o.name for o in got] == [f"{tid}.0.mesh"]
+        outs[tid] = got[0]
+    for tid, src in (("tenantA", mA), ("tenantB", mB)):
+        mr, fc, nc = load_distributed_mesh(tmp_path / f"{tid}.mesh", 0)
+        assert fc == [] and nc == []       # comms=None: no sections
+        vm = np.asarray(src.vmask)
+        tm = np.asarray(src.tmask)
+        assert (mr.vert == np.asarray(src.vert, np.float64)[vm]).all()
+        assert (mr.vref == np.asarray(src.vref)[vm]).all()
+        assert (mr.tref == np.asarray(src.tref)[tm]).all()
+        # live connectivity survives the compact renumber bit-for-bit
+        # (the compacted numbering IS the live prefix here)
+        assert (mr.tetra == np.asarray(src.tet)[tm]).all()
+
+
 def _write_split_cube(tmp_path, n=2):
     """Two-shard distributed fixture: centroid-split cube halves written
     as name.<rank>.mesh files; returns (vert, tet, part)."""
