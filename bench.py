@@ -338,31 +338,34 @@ def main() -> None:
         for r in regressions:
             print(f"bench:   {r}", file=sys.stderr)
 
-    print(json.dumps({
-        "metric": "adapt_cycle_throughput",
-        "value": round(mtets_per_sec, 4),
-        "unit": "Mtets/sec/chip",
-        "vs_baseline": round(mtets_per_sec / BASELINE_MTETS_PER_SEC, 3),
-        "extra": {"ntets_final": ntets_final, "qmin": round(qmin, 4),
-                  "qmean": round(qmean, 4), "cycles": cycles,
-                  "sum_rate": round(mtets_sum, 4),
-                  "narrow_cycles": narrow_cycles,
-                  "aniso": aniso,
-                  # grouped-analysis double-extraction cost (seconds per
-                  # [12*capT] extraction at this mesh shape) + the
-                  # quiet-group scheduler datapoint (BENCH_GROUPED=1)
-                  "extract2x_s": extract2x_s,
-                  "group_sched": group_sched,
-                  "device": str(jax.devices()[0].platform),
-                  "fallback": os.environ.get(
-                      "PARMMG_BENCH_FALLBACK", "") == "1",
-                  # compile-churn accounting (utils/compilecache): per
-                  # governed entry point {calls, variants, compiles,
-                  # compile_s} — a regression shows up as variants or
-                  # compiles growing with the cycle count
-                  "compile_ledger": ledger,
-                  "ledger_regressions": regressions},
-    }))
+    # canonical schema-versioned artifact (obs/artifact.py): the legacy
+    # top-level keys stay put, the env/metrics/trace blocks ride along
+    from parmmg_tpu.obs.artifact import make_artifact
+    print(json.dumps(make_artifact(
+        "BENCH",
+        metric="adapt_cycle_throughput",
+        value=round(mtets_per_sec, 4),
+        unit="Mtets/sec/chip",
+        vs_baseline=round(mtets_per_sec / BASELINE_MTETS_PER_SEC, 3),
+        extra={"ntets_final": ntets_final, "qmin": round(qmin, 4),
+               "qmean": round(qmean, 4), "cycles": cycles,
+               "sum_rate": round(mtets_sum, 4),
+               "narrow_cycles": narrow_cycles,
+               "aniso": aniso,
+               # grouped-analysis double-extraction cost (seconds per
+               # [12*capT] extraction at this mesh shape) + the
+               # quiet-group scheduler datapoint (BENCH_GROUPED=1)
+               "extract2x_s": extract2x_s,
+               "group_sched": group_sched,
+               "device": str(jax.devices()[0].platform),
+               "fallback": os.environ.get(
+                   "PARMMG_BENCH_FALLBACK", "") == "1",
+               # compile-churn accounting (utils/compilecache): per
+               # governed entry point {calls, variants, compiles,
+               # compile_s} — a regression shows up as variants or
+               # compiles growing with the cycle count
+               "compile_ledger": ledger,
+               "ledger_regressions": regressions})))
 
 
 def _ledger_regressions_vs_previous(ledger: dict) -> list[str]:

@@ -135,9 +135,14 @@ def parmmg_run(pm) -> tuple[Mesh, object, AdaptStats]:
     (adapted core Mesh, metric, stats)."""
     from .utils.timers import Timers
     from .api.params import check_input_data
+    from .obs import trace as otrace
     info = pm.info
     check_input_data(info, met_is_aniso=(
         pm.met is not None and getattr(pm.met, "ndim", 1) == 2))
+    # telemetry spine: fresh run context (run id + backend tag on every
+    # trace record) and the process verbosity = the reference's imprim
+    otrace.new_run()
+    otrace.set_verbosity(info.imprim)
     tim = Timers()
     with tim("analysis"):
         mesh, met = pm._build_core_mesh()
@@ -312,11 +317,11 @@ def parmmg_run(pm) -> tuple[Mesh, object, AdaptStats]:
             # (failed_handling, libparmmg1.c:974-1011)
             mesh, met, part = e.mesh, e.met, e.part
             stats.status = C.PMMG_LOWFAILURE
-            if info.imprim >= 0:
-                import sys
-                print("  ## Warning: shard capacity exhausted; "
-                      "saving the last conforming mesh "
-                      "(LOWFAILURE).", file=sys.stderr)
+            from .obs.trace import log as _olog
+            _olog(C.PMMG_VERB_VERSION,
+                  "  ## Warning: shard capacity exhausted; saving the "
+                  "last conforming mesh (LOWFAILURE).",
+                  verbose=info.imprim, err=True)
         # bad-element optimization on the merged mesh (same contract as
         # the single-device path: sliver_polish after the sizing loop)
         if not (info.noinsert and info.noswap and info.nomove):
@@ -348,6 +353,7 @@ def _finish_run(pm, mesh, met, stats, info, tim, bg_mesh, bg_fields,
     """Common run tail: sequential sliver repair, FEM-topology
     conformity, user-field interpolation, reports.  Shared by the
     whole-mesh, grouped and distributed paths."""
+    from .obs.trace import log as _olog
     # sequential last-resort repair: tangled sliver clusters (stacked
     # near-flat tets, typically born at former frozen interfaces) veto
     # every BATCHED fix — each parallel op inverts a neighbor — while the
@@ -359,8 +365,10 @@ def _finish_run(pm, mesh, met, stats, info, tim, bg_mesh, bg_fields,
             mesh, nrep = repair_mesh(
                 mesh, met, allow_collapse=not info.noinsert,
                 allow_swap=not info.noswap, allow_move=not info.nomove)
-            if nrep and info.imprim >= C.PMMG_VERB_STEPS:
-                print(f"  sequential repair: {nrep} cluster ops")
+            if nrep:
+                _olog(C.PMMG_VERB_STEPS,
+                      f"  sequential repair: {nrep} cluster ops",
+                      verbose=info.imprim)
 
     # FEM-mode topology fix (default ON like the reference,
     # API_functions_pmmg.c:413; disabled by -nofem): split interior edges
@@ -383,44 +391,55 @@ def _finish_run(pm, mesh, met, stats, info, tim, bg_mesh, bg_fields,
                     continue
                 if nf == 0:
                     break
-            if nf and info.imprim >= 0:
-                import sys
-                print("  ## Warning: fem conformity pass did not "
+            if nf:
+                _olog(C.PMMG_VERB_VERSION,
+                      "  ## Warning: fem conformity pass did not "
                       f"converge ({nf} edges remain); output may "
                       "contain elements with two boundary faces.",
-                      file=sys.stderr)
+                      verbose=info.imprim, err=True)
 
     # interpolate user fields old mesh -> new mesh
     if bg_fields:
         with tim("metric and fields interpolation"):
             pm.fields = interpolate_fields(bg_mesh, bg_fields, mesh)
 
+    # metrics spine: every run's counters land in the process registry
+    # (tenant-tagged stats stay namespaced), snapshotted by the
+    # artifact layer (obs/artifact.py)
+    stats.publish()
+    # quality report stays gated on BOTH compute and print: generating
+    # it runs whole-mesh device programs, which the telemetry spine
+    # must never add to a quiet run (its absence from the trace means
+    # "not computed", not "suppressed" — README Observability)
     if info.imprim >= C.PMMG_VERB_QUAL:
         print_quality_report(mesh, met, info)
-    if info.imprim >= C.PMMG_VERB_STEPS:
-        # quiet-group scheduler accounting (parallel/sched.py): the
-        # active g/G trajectory + the dispatches the compaction saved
-        # on the grouped path's chunked dispatch loop
-        if stats.group_dispatches or stats.group_dispatches_saved:
-            traj = stats.sched_extra.get("active_groups_per_block", [])
-            line = (f"  -- QUIET-GROUP SCHEDULER  "
-                    f"{stats.group_dispatches} group-block dispatches, "
-                    f"{stats.group_dispatches_saved} saved "
-                    f"({stats.groups_skipped} group-blocks skipped)")
-            if traj:
-                line += "; active g/block " + \
-                    ",".join(str(a) for a in traj)
-            print(line)
-        print(tim.report())
-        # compile-churn accounting (utils/compilecache): a steady state
-        # whose ledger keeps growing is recompiling, not computing
-        from .utils.timers import format_ledger, ledger_snapshot
-        # registration alone (import-time @governed) leaves all-zero
-        # rows; only print once something was actually called/compiled
-        if any(r["calls"] or r["compiles"]
-               for r in ledger_snapshot().values()):
-            print("  -- COMPILE LEDGER (XLA backend compiles)")
-            print(format_ledger())
+    # the report lines below are cheap host strings: _olog gates the
+    # PRINT on imprim but always emits the trace record, so the JSONL
+    # stream carries them (shown=false) even on quiet runs
+    # quiet-group scheduler accounting (parallel/sched.py): the active
+    # g/G trajectory + the dispatches the compaction saved on the
+    # grouped path's chunked dispatch loop
+    if stats.group_dispatches or stats.group_dispatches_saved:
+        traj = stats.sched_extra.get("active_groups_per_block", [])
+        line = (f"  -- QUIET-GROUP SCHEDULER  "
+                f"{stats.group_dispatches} group-block dispatches, "
+                f"{stats.group_dispatches_saved} saved "
+                f"({stats.groups_skipped} group-blocks skipped)")
+        if traj:
+            line += "; active g/block " + \
+                ",".join(str(a) for a in traj)
+        _olog(C.PMMG_VERB_STEPS, line, verbose=info.imprim)
+    _olog(C.PMMG_VERB_STEPS, tim.report(), verbose=info.imprim)
+    # compile-churn accounting (utils/compilecache): a steady state
+    # whose ledger keeps growing is recompiling, not computing
+    from .utils.timers import format_ledger, ledger_snapshot
+    # registration alone (import-time @governed) leaves all-zero
+    # rows; only report once something was actually called/compiled
+    if any(r["calls"] or r["compiles"]
+           for r in ledger_snapshot().values()):
+        _olog(C.PMMG_VERB_STEPS,
+              "  -- COMPILE LEDGER (XLA backend compiles)\n"
+              + format_ledger(), verbose=info.imprim)
     return mesh, met, stats
 
 
@@ -429,22 +448,33 @@ def print_quality_report(mesh: Mesh, met, info) -> None:
     PMMG_prilen, quality_pmmg.c:156,591 — the custom MPI_Op reductions
     become plain array reductions on the merged mesh / psums on shards)."""
     import jax.numpy as jnp
+    from .obs.metrics import REGISTRY
+    from .obs.trace import log as _olog
     from .ops.quality import tet_quality, quality_histogram, \
         length_histogram
 
     q = tet_quality(mesh, met)
     counts, qmin, qmean, nbad = quality_histogram(q, mesh.tmask)
-    print(f"  -- MESH QUALITY   {int(jnp.sum(mesh.tmask))} tets ; "
-          f"worst {float(qmin):.6f} ; mean {float(qmean):.6f} ; "
-          f"bad {int(nbad)}")
+    # quality gauges only exist when the quality report ran (imprim >=
+    # VERB_QUAL at the callsite): computing them is a whole-mesh device
+    # program, and the telemetry spine must never ADD device compute to
+    # a quiet run — absent quality.* gauges in an artifact mean the run
+    # skipped the report, not that quality regressed
+    REGISTRY.gauge("quality.qmin").set(float(qmin))
+    REGISTRY.gauge("quality.qmean").set(float(qmean))
+    REGISTRY.gauge("quality.nbad").set(float(nbad))
+    lines = [f"  -- MESH QUALITY   {int(jnp.sum(mesh.tmask))} tets ; "
+             f"worst {float(qmin):.6f} ; mean {float(qmean):.6f} ; "
+             f"bad {int(nbad)}"]
     c = np.asarray(counts)
     for i, n in enumerate(c):
         lo, hi = i / len(c), (i + 1) / len(c)
-        print(f"     {lo:.1f} < Q < {hi:.1f}   {int(n)}")
+        lines.append(f"     {lo:.1f} < Q < {hi:.1f}   {int(n)}")
     if met is not None:
         lc, lmin, lmax, lmean = length_histogram(mesh, met)
-        print(f"  -- EDGE LENGTHS   min {float(lmin):.4f} ; "
-              f"max {float(lmax):.4f} ; mean {float(lmean):.4f}")
+        lines.append(f"  -- EDGE LENGTHS   min {float(lmin):.4f} ; "
+                     f"max {float(lmax):.4f} ; mean {float(lmean):.4f}")
+    _olog(C.PMMG_VERB_QUAL, "\n".join(lines), verbose=info.imprim)
 
 
 def interpolate_fields(bg: Mesh, fields: list[np.ndarray], new: Mesh)\

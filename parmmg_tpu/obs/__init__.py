@@ -1,0 +1,30 @@
+"""Unified telemetry spine (tracing, metrics, artifacts).
+
+The reference's only observability is the ``PMMG_ctim[TIMEMAX]`` timer
+slots plus ``imprim``-gated prints (parmmg.c:35,91; libparmmg1.c:636-948).
+This reproduction outgrew that: wall-clock ``utils.timers.Timers``, the
+``jax.monitoring`` compile ledger, ``AdaptStats`` counters, scheduler
+trajectories and four ad-hoc artifact schemas each told a partial,
+incompatible story.  ``obs`` is the one spine they all emit into:
+
+- :mod:`~parmmg_tpu.obs.trace` — structured span/event/log emitter with
+  a run context (run id, backend, pass/block/chunk, tenant), a JSONL
+  sink (``PARMMG_TRACE=path``) over an always-on ring buffer, plus the
+  ``jax.profiler`` capture-window arming (``PARMMG_PROFILE_DIR``) and
+  device-timeline annotation wrappers;
+- :mod:`~parmmg_tpu.obs.metrics` — typed counter/gauge/histogram
+  registry (fixed log buckets, pure host) with Prometheus-style text
+  exposition and a JSON snapshot; tenant-tagged series stay namespaced
+  exactly like ``AdaptStats`` (``tenant:<id>/``);
+- :mod:`~parmmg_tpu.obs.artifact` — the canonical schema-versioned
+  artifact every bench/scale/serve/multihost script emits, and the
+  cross-artifact regression differ behind
+  ``scripts/ledger_check.py --diff``.
+
+Everything here is host-side bookkeeping: no jax import at module
+scope, no effect on compiled programs (gated by
+``scripts/run_tests.sh --obs``: trace-on adds zero compile families).
+"""
+from . import artifact, metrics, trace                     # noqa: F401
+from .metrics import REGISTRY                              # noqa: F401
+from .trace import TRACER, log, set_verbosity              # noqa: F401

@@ -1,0 +1,429 @@
+"""Structured trace emitter + run context + profiler arming.
+
+One record per completed span (not begin/end pairs): replay is a plain
+per-name sum, the file stays half the size, and a crashed run loses at
+most the spans still open.  Records are dicts; the run context
+(:func:`set_context` for process-wide keys like the run id and backend,
+:func:`context` for scoped overlays like pass/block/chunk/tenant) is
+folded into every record at emit time, so a trace line is
+self-describing without a join.
+
+Sinks: an always-on ring buffer (``PARMMG_TRACE_RING`` records, default
+4096 — the ``PMMG_ctim`` slots' bounded-memory role) and, when
+``PARMMG_TRACE=path`` is set (or :meth:`Tracer.configure` is called), a
+JSONL file appended line-by-line.  ``utils.timers.Timers`` feeds spans
+directly — every existing ``with tim(...)`` scope is a trace span for
+free, carrying the instance's ``tim`` id so :func:`replay_totals` can
+reconstruct exactly one registry's ``report()`` from the stream.
+
+Device timelines: :func:`annotate` wraps
+``jax.profiler.TraceAnnotation`` (host events on the profiler timeline)
+and :func:`scope` wraps ``jax.named_scope`` (XLA op metadata), so a
+profiler capture carries the same phase names as the host trace.
+``PARMMG_PROFILE_DIR`` arms ``jax.profiler.start_trace`` over a
+requested outer-pass window (``PARMMG_PROFILE_PASS=start[:stop]``,
+default pass 0) via :func:`profile_pass_begin` / :func:`profile_pass_end`
+— called by the grouped and distributed outer loops and driven
+standalone by ``scripts/profile_adapt.py``.
+
+:func:`log` is the one verbosity-gated print path (the reference's
+``imprim`` levels, core.constants.PMMG_VERB_*): gated output AND an
+always-emitted trace record, so ``-v`` output and the trace stream
+cannot drift.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import deque
+from contextlib import contextmanager, nullcontext
+
+__all__ = [
+    "TRACER", "Tracer", "annotate", "context", "current_context",
+    "emit_span", "event", "log", "new_run", "profile_pass_begin",
+    "profile_pass_end", "profiling_active", "replay_totals", "scope",
+    "set_context", "set_verbosity", "span", "verbosity",
+]
+
+
+# ---------------------------------------------------------------------------
+# run context
+# ---------------------------------------------------------------------------
+_BASE: dict = {}
+_TLS = threading.local()
+
+
+def set_context(**kv) -> None:
+    """Merge process-wide context keys (run id, backend, tenant...).
+    ``None`` deletes a key."""
+    for k, v in kv.items():
+        if v is None:
+            _BASE.pop(k, None)
+        else:
+            _BASE[k] = v
+
+
+def new_run(backend: str | None = None) -> str:
+    """Start a fresh run context: new run id, optional backend tag
+    (defaulted from an already-imported jax — never imports it)."""
+    import sys
+    import uuid
+    if backend is None:
+        jax = sys.modules.get("jax")
+        if jax is not None:
+            try:
+                backend = jax.default_backend()
+            except Exception:
+                backend = None
+    _BASE.clear()
+    rid = uuid.uuid4().hex[:12]
+    set_context(run=rid, backend=backend)
+    return rid
+
+
+@contextmanager
+def context(**kv):
+    """Thread-local scoped context overlay (pass/cycle/block/chunk/
+    tenant...) folded into every record emitted inside the scope."""
+    stk = getattr(_TLS, "stack", None)
+    if stk is None:
+        stk = _TLS.stack = []
+    stk.append({k: v for k, v in kv.items() if v is not None})
+    try:
+        yield
+    finally:
+        stk.pop()
+
+
+def current_context() -> dict:
+    out = dict(_BASE)
+    for d in getattr(_TLS, "stack", ()) or ():
+        out.update(d)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# the tracer
+# ---------------------------------------------------------------------------
+class Tracer:
+    """Ring buffer + optional JSONL sink.  Thread-safe; the env sink
+    (``PARMMG_TRACE``) is resolved lazily on first emit so importing
+    this module never opens files."""
+
+    def __init__(self, ring: int | None = None, path: str | None = None):
+        if ring is None:
+            ring = int(os.environ.get("PARMMG_TRACE_RING", "4096")
+                       or 4096)
+        self.ring: deque = deque(maxlen=max(1, ring))
+        self._lock = threading.Lock()
+        self._emitted = 0
+        self._path = path
+        self._fh = None
+        self._env_checked = path is not None
+
+    def _sink(self):
+        if not self._env_checked:
+            self._env_checked = True
+            p = os.environ.get("PARMMG_TRACE", "")
+            if p:
+                self._path = p
+        if self._path and self._fh is None:
+            try:
+                self._fh = open(self._path, "a", buffering=1)
+            except OSError:
+                self._path = None
+        return self._fh
+
+    def configure(self, path: str | None = None,
+                  ring: int | None = None) -> None:
+        """Re-point the JSONL sink (None = ring only); resets the env
+        resolution so tests and the obs gate control the sink
+        explicitly."""
+        with self._lock:
+            if self._fh is not None:
+                try:
+                    self._fh.close()
+                except OSError:
+                    pass
+                self._fh = None
+            self._path = path
+            self._env_checked = True
+            if ring is not None:
+                self.ring = deque(maxlen=max(1, ring))
+
+    def reset(self) -> None:
+        with self._lock:
+            self.ring.clear()
+            self._emitted = 0
+
+    def emit(self, rec: dict) -> None:
+        rec.setdefault("ts", round(time.time(), 6))
+        for k, v in current_context().items():
+            rec.setdefault(k, v)
+        with self._lock:
+            self._emitted += 1
+            self.ring.append(rec)
+            fh = self._sink()
+            if fh is not None:
+                try:
+                    fh.write(json.dumps(rec) + "\n")
+                except (OSError, TypeError, ValueError):
+                    pass
+
+    def summary(self, top: int = 8) -> dict:
+        """Compact trace digest for artifacts: emit/drop counts, sink,
+        and the top span totals seen in the ring."""
+        with self._lock:
+            recs = list(self.ring)
+            emitted = self._emitted
+        tot: dict[str, float] = {}
+        for r in recs:
+            if r.get("kind") == "span":
+                tot[r["name"]] = tot.get(r["name"], 0.0) \
+                    + float(r.get("dur", 0.0))
+        tops = sorted(tot.items(), key=lambda kv: -kv[1])[:top]
+        return {"events": emitted, "ring": len(recs),
+                "dropped": max(0, emitted - len(recs)),
+                "sink": self._path or "",
+                "top_spans_s": {k: round(v, 4) for k, v in tops}}
+
+
+TRACER = Tracer()
+
+
+def emit_span(name: str, dur: float, count: int = 1,
+              tim: int | None = None, ext: bool = False) -> None:
+    """One completed span.  ``tim``: emitting Timers instance id (the
+    replay filter); ``ext``: segment absorbed from another component's
+    measurement (Timers.add outside any scope)."""
+    rec = {"kind": "span", "name": name, "dur": round(float(dur), 9),
+           "count": int(count)}
+    if tim is not None:
+        rec["tim"] = tim
+    if ext:
+        rec["ext"] = True
+    TRACER.emit(rec)
+
+
+@contextmanager
+def span(name: str, **fields):
+    """Measure-and-emit convenience for code without a Timers."""
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        rec = {"kind": "span", "name": name,
+               "dur": round(time.perf_counter() - t0, 9), "count": 1}
+        rec.update(fields)
+        TRACER.emit(rec)
+
+
+def event(name: str, **fields) -> None:
+    rec = {"kind": "event", "name": name}
+    rec.update(fields)
+    TRACER.emit(rec)
+
+
+def replay_totals(source, tim: int | None = None
+                  ) -> tuple[dict, dict]:
+    """Reconstruct per-phase (total seconds, counts) from a trace — a
+    JSONL path or an iterable of records.  ``tim`` filters to one
+    Timers instance so the result is comparable to that instance's
+    ``acc``/``count`` (the ``--obs`` gate's replay check).  Unparseable
+    lines are skipped (a crashed writer may truncate the last one)."""
+    if isinstance(source, (str, os.PathLike)):
+        recs = []
+        with open(source) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    recs.append(json.loads(line))
+                except ValueError:
+                    continue
+    else:
+        recs = list(source)
+    tot: dict[str, float] = {}
+    cnt: dict[str, int] = {}
+    for r in recs:
+        if r.get("kind") != "span":
+            continue
+        if tim is not None and r.get("tim") != tim:
+            continue
+        n = r["name"]
+        tot[n] = tot.get(n, 0.0) + float(r.get("dur", 0.0))
+        cnt[n] = cnt.get(n, 0) + int(r.get("count", 1))
+    return tot, cnt
+
+
+# ---------------------------------------------------------------------------
+# verbosity-gated logging (imprim analogue)
+# ---------------------------------------------------------------------------
+_VERBOSITY = [int(os.environ.get("PARMMG_VERBOSE", "1") or 1)]
+
+
+def set_verbosity(v: int) -> None:
+    """Set the process verbosity (the reference's ``imprim``; the
+    driver calls this from ``info.imprim`` at run start)."""
+    _VERBOSITY[0] = int(v)
+
+
+def verbosity() -> int:
+    return _VERBOSITY[0]
+
+
+def log(level: int, msg: str, verbose: int | None = None,
+        err: bool = False) -> bool:
+    """Verbosity-gated print + unconditional trace record.
+
+    ``level``: the imprim threshold (core.constants.PMMG_VERB_*).
+    ``verbose``: optional local verbosity (the dist/groups drivers
+    carry one on the same scale) — overrides the process value.  The
+    record is emitted whether or not the line printed (``shown``
+    flags it), so the trace stream and the -v output cannot drift.
+    Returns whether the line printed."""
+    gate = _VERBOSITY[0] if verbose is None else int(verbose)
+    shown = gate >= level
+    TRACER.emit({"kind": "log", "lvl": int(level), "msg": str(msg),
+                 "shown": bool(shown)})
+    if shown:
+        import sys
+        print(msg, file=sys.stderr if err else sys.stdout)
+    return shown
+
+
+# ---------------------------------------------------------------------------
+# jax profiler integration (capture windows + timeline annotations)
+# ---------------------------------------------------------------------------
+_PROFILE = {"active": False, "dir": "", "window": (0, 0)}
+
+
+def _profile_conf():
+    d = os.environ.get("PARMMG_PROFILE_DIR", "")
+    if not d:
+        return None
+    w = os.environ.get("PARMMG_PROFILE_PASS", "0")
+    if ":" in w:
+        a, b = w.split(":", 1)
+        win = (int(a or 0), int(b or a or 0))
+    else:
+        win = (int(w or 0), int(w or 0))
+    return d, win
+
+
+def profile_pass_begin(it: int) -> bool:
+    """Arm a ``jax.profiler`` capture when outer pass ``it`` enters the
+    requested window (``PARMMG_PROFILE_DIR`` + ``PARMMG_PROFILE_PASS``).
+    No-op (False) when unarmed, already capturing, or out of window."""
+    conf = _profile_conf()
+    if conf is None or _PROFILE["active"]:
+        return False
+    d, (a, b) = conf
+    if not (a <= it <= b):
+        return False
+    try:
+        import jax
+        os.makedirs(d, exist_ok=True)
+        jax.profiler.start_trace(d)
+    except Exception as e:
+        log(0, f"obs: profiler capture failed to arm ({e!r})", err=True)
+        return False
+    _PROFILE.update(active=True, dir=d, window=(a, b))
+    event("profile_start", dir=d)
+    return True
+
+
+def profile_pass_end(it: int) -> bool:
+    """Close the capture once the window's last pass completed."""
+    if not _PROFILE["active"]:
+        return False
+    _a, b = _PROFILE["window"]
+    if it < b:
+        return False
+    try:
+        import jax
+        jax.profiler.stop_trace()
+    except Exception:
+        pass
+    _PROFILE["active"] = False
+    event("profile_stop", dir=_PROFILE["dir"])
+    # stderr: stdout is the artifact channel of every emitting script
+    log(1, f"obs: profiler trace written to {_PROFILE['dir']}",
+        err=True)
+    return True
+
+
+def profile_abort() -> bool:
+    """Unconditionally close an active capture — the exception-unwind
+    path of the pass loops (a capture left open would both leak and
+    make every later :func:`profile_pass_begin` refuse to arm)."""
+    if not _PROFILE["active"]:
+        return False
+    try:
+        import jax
+        jax.profiler.stop_trace()
+    except Exception:
+        pass
+    _PROFILE["active"] = False
+    event("profile_abort", dir=_PROFILE["dir"])
+    return True
+
+
+def profiling_active() -> bool:
+    return _PROFILE["active"]
+
+
+def profile_guard(clear_pass: bool = False):
+    """Decorator for outer pass loops that arm capture windows: an
+    exception unwinding the loop (capacity MemoryError, device OOM,
+    ShardOverflowError degrade) must not leave a capture open (an open
+    capture makes every later arm attempt a silent no-op) — only a
+    capture the wrapped call itself armed is aborted.  ``clear_pass``
+    also drops a process-global ``pass`` context tag the loop set (the
+    scoped :func:`context` form unwinds by itself and needs nothing)."""
+    import functools
+
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            profiling_before = profiling_active()
+            try:
+                return fn(*args, **kwargs)
+            finally:
+                if clear_pass:
+                    set_context(**{"pass": None})
+                if not profiling_before:
+                    profile_abort()
+        return wrapper
+    return deco
+
+
+def annotate(name: str):
+    """Host-side device-timeline annotation
+    (``jax.profiler.TraceAnnotation``) — active only while a capture
+    runs, a free nullcontext otherwise (hot dispatch loops wrap every
+    chunk in this)."""
+    if not _PROFILE["active"]:
+        return nullcontext()
+    try:
+        import jax
+        return jax.profiler.TraceAnnotation(name)
+    except Exception:
+        return nullcontext()
+
+
+def scope(name: str):
+    """``jax.named_scope`` wrapper for traced code: XLA ops inside
+    carry ``name`` on the device timeline.  Nullcontext when jax is not
+    imported (host-only contexts must stay jax-free)."""
+    import sys
+    jax = sys.modules.get("jax")
+    if jax is None:
+        return nullcontext()
+    try:
+        return jax.named_scope(name)
+    except Exception:
+        return nullcontext()

@@ -87,6 +87,14 @@ class AdaptStats:
                 self.sched_extra[kk] = self.sched_extra.get(kk, 0.0) + v
         return self
 
+    def publish(self, registry=None) -> None:
+        """Publish the counters into the obs metrics registry
+        (obs/metrics.py): tenant-tagged stats land as tenant-namespaced
+        series, the same ``tenant:<id>/`` convention as sched_extra.
+        The cross-tenant isolation contract stays in ``__iadd__``."""
+        from ..obs.metrics import publish_stats
+        publish_stats(self, registry)
+
 
 def adapt_cycle_impl(mesh: Mesh, met: jax.Array, wave: jax.Array,
                      do_swap: bool = True, do_smooth: bool = True,

@@ -465,8 +465,16 @@ def packed_halo_rows(nbr: np.ndarray, G: int,
         # tile (headers ride along; require a strict row win)
         if M >= G * G:
             M = None
+    # metrics spine: layout decisions + hysteresis flips are the churn
+    # signal the BENCH/SCALE metrics block surfaces (obs/metrics.py)
+    from ..obs.metrics import REGISTRY
+    layout = "packed" if M is not None else "dense"
+    REGISTRY.counter(f"halo.layout_{layout}").inc()
     if state is not None:
-        state["layout"] = "packed" if M is not None else "dense"
+        prev = state.get("layout")
+        if prev is not None and prev != layout:
+            REGISTRY.counter("halo.layout_flips").inc()
+        state["layout"] = layout
     return M
 
 

@@ -29,6 +29,7 @@ from jax.sharding import Mesh as DeviceMesh, PartitionSpec as P, NamedSharding
 from ..utils.jaxcompat import shard_map
 
 from ..core.mesh import Mesh
+from ..obs import trace as otrace
 from ..ops.quality import tet_quality, quality_histogram
 from ..utils.compilecache import bucket, governed
 
@@ -543,10 +544,10 @@ def run_adapt_cycles(stacked, met_s, steps: DistSteps, cycles,
                 # the grouped path's active_groups_per_block)
                 stats.sched_extra.setdefault(
                     "active_shards_per_cycle", []).append(int(na[i]))
-            if verbose >= 3:
-                print(f"  {label} cycle {c + i}: split {cs[0]} "
-                      f"collapse {cs[1]} swap {cs[2]} move {cs[3]} "
-                      f"active {int(na[i])}/{n_logical} grp")
+            otrace.log(3, f"  {label} cycle {c + i}: split {cs[0]} "
+                          f"collapse {cs[1]} swap {cs[2]} move {cs[3]} "
+                          f"active {int(na[i])}/{n_logical} grp",
+                       verbose=verbose)
         if int(ovf) != 0:
             if regrow_state[0] >= MAX_SHARD_REGROWS:
                 m_, k_, p_ = merge_shards(stacked, met_s,
@@ -662,6 +663,7 @@ def distributed_adapt(mesh: Mesh, met, n_shards: int,
     return merged, met_m, part_new
 
 
+@otrace.profile_guard(clear_pass=True)
 def distributed_adapt_multi(mesh: Mesh, met, n_shards: int,
                             niter: int = 3, cycles: int = 10,
                             dmesh: DeviceMesh | None = None,
@@ -825,6 +827,10 @@ def distributed_adapt_multi(mesh: Mesh, met, n_shards: int,
     regrow_state = [0]
     ana_cache: dict = {}
     for it in range(max(1, niter)):
+        # profiler capture window + pass tag on every trace record
+        # emitted inside this outer iteration (obs/trace.py)
+        otrace.profile_pass_begin(it)
+        otrace.set_context(**{"pass": it})
         capP_before = stacked.vert.shape[1]
         stacked, met_s = run_adapt_cycles(
             stacked, met_s, steps, cycles, dmesh,
@@ -980,9 +986,10 @@ def distributed_adapt_multi(mesh: Mesh, met, n_shards: int,
                         check_interface_echo(stacked, met_s, comms,
                                              dmesh, vert_h, G=G,
                                              pack_state=pack_state)
-                elif verbose >= 1:
-                    print(f"  it {it}: band budgets exceeded — "
-                          "falling back to the full-view path")
+                else:
+                    otrace.log(1, f"  it {it}: band budgets exceeded — "
+                                  "falling back to the full-view path",
+                               verbose=verbose)
             if not band_done:
                 if multi:
                     raise NotImplementedError(
@@ -1030,9 +1037,11 @@ def distributed_adapt_multi(mesh: Mesh, met, n_shards: int,
                 if use_band:    # resync the device numbering copy
                     glo_d = jnp.asarray(np.stack(glo).astype(np.int32))
                     shared_prev = _shared_gids(comms, glo, n_shards)
-            if nmoved and verbose >= 2:
-                print(f"  it {it}: migrated {nmoved} interface-band "
-                      "tets")
+            if nmoved:
+                otrace.log(2, f"  it {it}: migrated {nmoved} "
+                              "interface-band tets", verbose=verbose)
+        otrace.profile_pass_end(it)
+    otrace.set_context(**{"pass": None})
     if multi:
         # final output: replicate the (end-state) shards to every
         # process and merge identically everywhere — the

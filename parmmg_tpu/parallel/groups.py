@@ -42,6 +42,7 @@ import jax.numpy as jnp
 
 from ..core.mesh import Mesh
 from ..core import constants as C
+from ..obs import trace as otrace
 
 
 def how_many_groups(ne: int, target: int) -> int:
@@ -143,11 +144,14 @@ def _group_block(flags: tuple, pres: tuple, nomove: bool,
         m, k, wave = args
         counts_all = []
         for cc, dosw in enumerate(flags):
-            m, k, counts = adapt_cycle_impl(
-                m, k, wave + cc, do_swap=dosw,
-                do_smooth=not nomove, do_insert=not noinsert,
-                hausd=hausd, final_rebuild=(cc == len(flags) - 1),
-                prescreen=pres[cc])
+            # named_scope: XLA ops of each unrolled cycle carry the
+            # phase name on a profiler's device timeline (obs/trace.py)
+            with otrace.scope(f"grp_cycle{cc}"):
+                m, k, counts = adapt_cycle_impl(
+                    m, k, wave + cc, do_swap=dosw,
+                    do_smooth=not nomove, do_insert=not noinsert,
+                    hausd=hausd, final_rebuild=(cc == len(flags) - 1),
+                    prescreen=pres[cc])
             counts_all.append(counts)
         return m, k, jnp.stack(counts_all)       # [n, 6]
 
@@ -267,7 +271,8 @@ def _pipeline_chunks(fn, stacked, met_s, wave, plans, tim):
         with tim("upload"):
             sl = jax.tree.map(lambda a: jnp.asarray(a[idx]), stacked)
             kl = jnp.asarray(met_s[idx])
-        m, k, cnt = fn(sl, kl, wave)
+        with otrace.annotate(f"grp_dispatch_chunk{pi}"):
+            m, k, cnt = fn(sl, kl, wave)
         if pending is not None:
             drain(pending)
         pending = (pi, idx, nreal, m, k, cnt)
@@ -373,18 +378,20 @@ def grouped_adapt_pass(mesh: Mesh, met, ngroups: int, cycles: int = 12,
         pres_all_on = all(pres)
         wave = jnp.asarray(c, jnp.int32)
         act, plans = sched.plan_block(pres_all_on)
-        if chunk:
-            parts = _pipeline_chunks(step, stacked, met_s, wave, plans,
-                                     ltim)
-            counts_act = np.concatenate(parts) if parts else \
-                np.zeros((0, nblk, 8), np.int32)
-            if verbose >= 2 and sched.enabled:
-                print(f"  grp block {c}..{c + nblk - 1}: active "
-                      f"{len(act)}/{g_exec} groups, {len(plans)} "
-                      "dispatches")
-        else:
-            stacked, met_s, counts = step(stacked, met_s, wave)
-            counts_act = np.asarray(counts)     # [g_exec, nblk, 8]
+        with otrace.context(block=c, chunk=chunk or 0):
+            if chunk:
+                parts = _pipeline_chunks(step, stacked, met_s, wave,
+                                         plans, ltim)
+                counts_act = np.concatenate(parts) if parts else \
+                    np.zeros((0, nblk, 8), np.int32)
+                if sched.enabled:
+                    otrace.log(
+                        2, f"  grp block {c}..{c + nblk - 1}: active "
+                           f"{len(act)}/{g_exec} groups, {len(plans)} "
+                           "dispatches", verbose=verbose)
+            else:
+                stacked, met_s, counts = step(stacked, met_s, wave)
+                counts_act = np.asarray(counts)  # [g_exec, nblk, 8]
         sched.record_block(act, counts_act, swap_inc, pres_all_on)
         # quiet groups contribute exact zeros (that is what marked them)
         cs = counts_act.sum(axis=0, dtype=np.int64)     # [nblk, 8]
@@ -396,10 +403,10 @@ def grouped_adapt_pass(mesh: Mesh, met, ngroups: int, cycles: int = 12,
                 stats.nswap += int(tot[2])
                 stats.nmoved += int(tot[3])
                 stats.cycles += 1
-            if verbose >= 3:
-                print(f"  grp cycle {c + i}: split {tot[0]} collapse "
-                      f"{tot[1]} swap {tot[2]} move {tot[3]} over "
-                      f"{ngroups} groups")
+            otrace.log(3, f"  grp cycle {c + i}: split {tot[0]} "
+                          f"collapse {tot[1]} swap {tot[2]} move "
+                          f"{tot[3]} over {ngroups} groups",
+                       verbose=verbose)
         if int(cs[:, 4].max()) != 0:
             if regrows >= 6:
                 raise MemoryError("group capacity exhausted")
@@ -523,10 +530,10 @@ def grouped_adapt_pass(mesh: Mesh, met, ngroups: int, cycles: int = 12,
                 cnts = np.concatenate(parts)          # [n_act, 4]
                 pol_traj.append(len(pol_act))
                 tot = cnts.sum(axis=0, dtype=np.int64)
-                if verbose >= 2:
-                    print(f"  grp polish w{w}: collapse {int(tot[0])} "
-                          f"swap {int(tot[1])} move {int(tot[2])} over "
-                          f"{len(pol_act)} active groups")
+                otrace.log(2, f"  grp polish w{w}: collapse "
+                              f"{int(tot[0])} swap {int(tot[1])} move "
+                              f"{int(tot[2])} over {len(pol_act)} "
+                              "active groups", verbose=verbose)
                 pol_act = pol_act[(cnts[:, 0] + cnts[:, 1]) > 0]
         elif chunk:
             # per-chunk wave loop (PARMMG_GROUP_SCHED=0 legacy): each
@@ -540,10 +547,10 @@ def grouped_adapt_pass(mesh: Mesh, met, ngroups: int, cycles: int = 12,
                     sl, kl, cnt = polish_block(
                         sl, kl, jnp.asarray(2000 + w, jnp.int32))
                     tot = np.asarray(cnt).sum(axis=0)
-                    if verbose >= 2:
-                        print(f"  grp polish chunk {g0 // chunk} w{w}: "
-                              f"collapse {int(tot[0])} swap "
-                              f"{int(tot[1])} move {int(tot[2])}")
+                    otrace.log(2, f"  grp polish chunk {g0 // chunk} "
+                                  f"w{w}: collapse {int(tot[0])} swap "
+                                  f"{int(tot[1])} move {int(tot[2])}",
+                               verbose=verbose)
                     if int(tot[0]) == 0 and int(tot[1]) == 0:
                         break
                 _assign(stacked, sl, g0)
@@ -553,9 +560,9 @@ def grouped_adapt_pass(mesh: Mesh, met, ngroups: int, cycles: int = 12,
                 stacked, met_s, cnt = polish_block(
                     stacked, met_s, jnp.asarray(2000 + w, jnp.int32))
                 tot = np.asarray(cnt).sum(axis=0)
-                if verbose >= 2:
-                    print(f"  grp polish {w}: collapse {int(tot[0])} "
-                          f"swap {int(tot[1])} move {int(tot[2])}")
+                otrace.log(2, f"  grp polish {w}: collapse "
+                              f"{int(tot[0])} swap {int(tot[1])} move "
+                              f"{int(tot[2])}", verbose=verbose)
                 if int(tot[0]) == 0 and int(tot[1]) == 0:
                     break
     # fold the scheduler instrumentation: counters + the active-group
@@ -569,10 +576,21 @@ def grouped_adapt_pass(mesh: Mesh, met, ngroups: int, cycles: int = 12,
     chunk_rec = recommend_group_chunk(sched.active_per_block,
                                       g_exec if chunk else ngroups)
     note_chunk_recommendation(chunk_rec)
-    if verbose >= 2:
-        print(f"  grp chunk auto-tune: recommend PARMMG_GROUP_CHUNK="
-              f"{chunk_rec or 'unchunked'} (current "
-              f"{chunk or 'unchunked'})")
+    otrace.log(2, f"  grp chunk auto-tune: recommend "
+                  f"PARMMG_GROUP_CHUNK={chunk_rec or 'unchunked'} "
+                  f"(current {chunk or 'unchunked'})", verbose=verbose)
+    # metrics spine: the pass's scheduler counters + pipeline segment
+    # seconds land in the process registry regardless of whether the
+    # caller threaded a stats/timers object through
+    from ..obs.metrics import REGISTRY
+    REGISTRY.counter("groups.dispatches").inc(sched.dispatches)
+    REGISTRY.counter("groups.dispatches_saved").inc(
+        sched.saved_dispatches)
+    REGISTRY.counter("groups.group_blocks_skipped").inc(
+        sched.skipped_group_blocks)
+    REGISTRY.gauge("groups.chunk_recommendation").set(chunk_rec)
+    for k, v in ltim.acc.items():
+        REGISTRY.counter(f"groups.pipeline.{k}_s").inc(v)
     if stats is not None:
         stats.group_dispatches += sched.dispatches
         stats.group_dispatches_saved += sched.saved_dispatches
@@ -598,6 +616,7 @@ def grouped_adapt_pass(mesh: Mesh, met, ngroups: int, cycles: int = 12,
     return merge_shards(stacked, met_s, return_part=True)
 
 
+@otrace.profile_guard()
 def grouped_adapt(mesh: Mesh, met, target_size: int, niter: int = 3,
                   cycles: int = 12, verbose: int = 0, stats=None,
                   noinsert: bool = False, noswap: bool = False,
@@ -613,27 +632,33 @@ def grouped_adapt(mesh: Mesh, met, target_size: int, niter: int = 3,
 
     part = None
     for it in range(max(1, niter)):
-        ne = int(np.asarray(mesh.tmask).sum())
-        # a displaced partition fixes the group count (its labels index
-        # the previous split); fresh iterations re-derive it from ne
-        ngroups = (int(part.max()) + 1) if part is not None \
-            else how_many_groups(ne, target_size)
-        if ngroups < 2:
-            from ..ops.adapt import adapt_mesh
-            mesh, met, st = adapt_mesh(
-                mesh, met, verbose=verbose, noinsert=noinsert,
-                noswap=noswap, nomove=nomove, hausd=hausd)
-            if stats is not None:
-                stats += st
-            part = None
-            continue
-        mesh, met, part_m = grouped_adapt_pass(
-            mesh, met, ngroups, cycles=cycles, part=part,
-            verbose=verbose, stats=stats, noinsert=noinsert,
-            noswap=noswap, nomove=nomove, hausd=hausd,
-            timers=timers)
-        if it + 1 < max(1, niter):
-            _, tet_h, _, _, _ = mesh_to_host(mesh)
-            part = move_interfaces(tet_h, part_m, ngroups,
-                                   nlayers=ifc_layers)
+        # profiler capture window (PARMMG_PROFILE_DIR over the
+        # PARMMG_PROFILE_PASS outer-pass range — obs/trace.py)
+        otrace.profile_pass_begin(it)
+        with otrace.context(**{"pass": it}):
+            ne = int(np.asarray(mesh.tmask).sum())
+            # a displaced partition fixes the group count (its labels
+            # index the previous split); fresh iterations re-derive it
+            ngroups = (int(part.max()) + 1) if part is not None \
+                else how_many_groups(ne, target_size)
+            if ngroups < 2:
+                from ..ops.adapt import adapt_mesh
+                mesh, met, st = adapt_mesh(
+                    mesh, met, verbose=verbose, noinsert=noinsert,
+                    noswap=noswap, nomove=nomove, hausd=hausd)
+                if stats is not None:
+                    stats += st
+                part = None
+                otrace.profile_pass_end(it)
+                continue
+            mesh, met, part_m = grouped_adapt_pass(
+                mesh, met, ngroups, cycles=cycles, part=part,
+                verbose=verbose, stats=stats, noinsert=noinsert,
+                noswap=noswap, nomove=nomove, hausd=hausd,
+                timers=timers)
+            if it + 1 < max(1, niter):
+                _, tet_h, _, _, _ = mesh_to_host(mesh)
+                part = move_interfaces(tet_h, part_m, ngroups,
+                                       nlayers=ifc_layers)
+        otrace.profile_pass_end(it)
     return mesh, met

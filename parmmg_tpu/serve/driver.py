@@ -54,6 +54,7 @@ class ServeRequest:
     t_admit: float = 0.0
     t_done: float = 0.0
     quality: dict | None = None  # {"qmin", "qmean", "ntets"} SLO fields
+    slo: dict | None = None      # {"qmin_floor", "ok"} verdict
     stats: object = None         # tenant-tagged AdaptStats
     out_files: list = dataclasses.field(default_factory=list)
 
@@ -208,15 +209,18 @@ class ServeDriver:
             r.state = RUNNING
             r.t_admit = time.perf_counter()
             inflight += 1
-            if self.verbose:
-                # stderr: stdout belongs to the front-ends' JSON report
-                import sys
-                print(f"serve: admitted {tid} -> bucket "
-                      f"{got[1][0]}x{got[1][1]} slot {got[2]}",
-                      file=sys.stderr)
+            # stderr: stdout belongs to the front-ends' JSON report
+            from ..obs.trace import log as _olog
+            _olog(1, f"serve: admitted {tid} -> bucket "
+                     f"{got[1][0]}x{got[1][1]} slot {got[2]}",
+                  verbose=self.verbose, err=True)
         self.queue = remaining
+        from ..obs.metrics import REGISTRY
+        REGISTRY.gauge("serve.queue_depth").set(len(self.queue))
 
     def _retire(self, tid: str) -> None:
+        from ..obs.metrics import REGISTRY
+        from ..obs.trace import log as _olog
         from ..ops.quality import quality_histogram, tet_quality
         r = self.requests[tid]
         slot = self.pool.slot_of(tid)
@@ -239,13 +243,28 @@ class ServeDriver:
                          "nbad": int(nbad),
                          "ntets": int(np.asarray(mesh.tmask).sum())}
             r.state = DONE
+            # per-tenant SLO verdict (machine-readable, tenant-tagged):
+            # quality floor from PARMMG_SERVE_SLO_QMIN (0 = quality SLO
+            # off, verdict rides on completion alone)
+            import os
+            floor = float(os.environ.get("PARMMG_SERVE_SLO_QMIN", "0")
+                          or 0)
+            ok = r.quality["qmin"] >= floor
+            r.slo = {"qmin_floor": floor, "ok": ok}
+            REGISTRY.counter(
+                "serve.slo_ok" if ok else "serve.slo_violation",
+                tenant=tid).inc()
         r.t_done = time.perf_counter()
+        if r.state == DONE:
+            REGISTRY.histogram("serve.latency_s").observe(r.latency_s)
+        # per-tenant counters land tenant-namespaced in the registry
+        if r.stats is not None:
+            r.stats.publish()
         self.pool.release(tid)
-        if self.verbose:
-            import sys
-            print(f"serve: retired {tid} ({r.state}"
-                  + (f", qmin {r.quality['qmin']}" if r.quality else "")
-                  + f", {r.latency_s:.2f}s)", file=sys.stderr)
+        _olog(1, f"serve: retired {tid} ({r.state}"
+                 + (f", qmin {r.quality['qmin']}" if r.quality else "")
+                 + f", {r.latency_s:.2f}s)",
+              verbose=self.verbose, err=True)
 
     def _expire_timeouts(self) -> None:
         if not self.timeout_s:
@@ -302,6 +321,7 @@ class ServeDriver:
                 "reason": r.reason,
                 "latency_s": round(r.latency_s, 3),
                 "quality": r.quality,
+                "slo": r.slo,
                 "cycles": r.stats.cycles if r.stats else 0,
                 "ops": ([r.stats.nsplit, r.stats.ncollapse,
                          r.stats.nswap, r.stats.nmoved]

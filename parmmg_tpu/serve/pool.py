@@ -156,10 +156,12 @@ class SlotPool:
         """Try to admit a tenant: ("ok", key, slot) | ("full", key) |
         ("oversize", caps).  "full" tenants stay queued at the caller
         (the driver) until a converged tenant recycles its slot."""
+        from ..obs.metrics import REGISTRY
         if tenant in self._where:
             raise ValueError(f"tenant {tenant!r} already admitted")
         capP, capT = self.home_caps(n_vert, n_tet)
         if capP > self.max_capP or capT > self.max_capT:
+            REGISTRY.counter("serve.admit_oversize").inc()
             return ("oversize", (capP, capT))
         key = (capP, capT, int(met_width))
         b = self.buckets.get(key)
@@ -168,10 +170,12 @@ class SlotPool:
                                            self.slots_per_bucket)
         i = b.free_slot()
         if i is None:
+            REGISTRY.counter("serve.admit_full").inc()
             return ("full", key)
         from ..ops.adapt import AdaptStats
         b.slots[i] = Slot(tenant=tenant, stats=AdaptStats(tenant=tenant))
         self._where[tenant] = (key, i)
+        REGISTRY.counter("serve.admit_ok").inc()
         return ("ok", key, i)
 
     @staticmethod
@@ -359,6 +363,8 @@ class SlotPool:
         wave) and ride compacted [chunk, ...] dispatches of the SAME
         cached compiled programs the batch grouped path uses."""
         import jax.numpy as jnp
+        from ..obs import trace as otrace
+        from ..obs.metrics import REGISTRY
         from ..ops.adapt import default_cycle_block
         from ..parallel.groups import (_group_block, _pipeline_chunks,
                                        block_converged, block_schedule)
@@ -368,6 +374,13 @@ class SlotPool:
         done: list[str] = []
         block = default_cycle_block()
         for key, b in sorted(self.buckets.items()):
+            # same key spelling as occupancy(): the met-width suffix
+            # keeps scalar- and tensor-metric buckets of equal caps
+            # from colliding on one gauge series
+            occ, _nslots = b.occupancy()
+            REGISTRY.gauge(
+                f"serve.occupancy.{key[0]}x{key[1]}"
+                + (f"m{key[2]}" if key[2] else "")).set(occ)
             act = [(i, s) for i, s in enumerate(b.slots)
                    if s.tenant is not None and s.loaded
                    and not s.converged and not s.failed]
@@ -385,6 +398,7 @@ class SlotPool:
                                   self.noinsert, self.hausd)
                 plans = chunk_plans(np.asarray(ids), self.chunk)
                 self.dispatches += len(plans)
+                REGISTRY.counter("serve.dispatches").inc(len(plans))
                 parts = _pipeline_chunks(fn, b.stacked, b.met,
                                          jnp.asarray(c, jnp.int32),
                                          plans, self.timers)
@@ -416,11 +430,10 @@ class SlotPool:
                             or s.c >= self.cycles:
                         s.converged = True
                         done.append(s.tenant)
-                if verbose >= 2:
-                    import sys
-                    print(f"  serve step {self.steps} bucket "
-                          f"{key[0]}x{key[1]} c{c}: {len(ids)} tenants, "
-                          f"{len(plans)} dispatches", file=sys.stderr)
+                otrace.log(2, f"  serve step {self.steps} bucket "
+                              f"{key[0]}x{key[1]} c{c}: {len(ids)} "
+                              f"tenants, {len(plans)} dispatches",
+                           verbose=verbose, err=True)
         return done
 
     def run_to_completion(self, max_steps: int = 1000) -> list[str]:

@@ -4,6 +4,13 @@ The reference tracks per-phase times in ``PMMG_ctim[TIMEMAX]`` slots with
 verbosity-gated prints (parmmg.c:35,91; libparmmg1.c:636-948).  Here a
 small nestable timer registry with the same reporting role.
 
+Every completed scope ALSO emits a structured trace span
+(obs/trace.py) carrying this instance's ``trace_id``, so the JSONL
+trace replays to exactly this registry's totals
+(``obs.trace.replay_totals(path, tim=timers.trace_id)`` — the
+``run_tests.sh --obs`` gate's check).  Emission is a ring-buffer append
+when no sink is armed: safe in the chunk-pipeline hot loop.
+
 The compile ledger (utils/compilecache.py) is re-exported here so the
 drivers' reporting layer has ONE import surface for both wall-clock and
 compile accounting: ``Timers.report`` for phases,
@@ -11,6 +18,7 @@ compile accounting: ``Timers.report`` for phases,
 """
 from __future__ import annotations
 
+import itertools
 import time
 from contextlib import contextmanager
 
@@ -18,12 +26,33 @@ from .compilecache import (                                    # noqa: F401
     LEDGER, format_ledger, ledger_snapshot, ledger_violations,
     reset_ledger)
 
+_EMIT = None        # lazily-bound obs.trace.emit_span (False = unavailable)
+
+
+def _emit_span(path, dur, count=1, tim=None, ext=False) -> None:
+    global _EMIT
+    if _EMIT is None:
+        try:
+            from ..obs.trace import emit_span
+            _EMIT = emit_span
+        except Exception:       # pragma: no cover - obs is always present
+            _EMIT = False
+    if _EMIT:
+        _EMIT(path, dur, count=count, tim=tim, ext=ext)
+
 
 class Timers:
+    _IDS = itertools.count(1)
+
     def __init__(self):
         self.acc: dict[str, float] = {}
         self.count: dict[str, int] = {}
         self._stack: list[tuple[str, float]] = []
+        # paths absorbed via add() OUTSIDE any active scope: externally
+        # measured segments, rendered distinctly by report()
+        self.external: set[str] = set()
+        # stable id stamped on every emitted span (the replay filter)
+        self.trace_id: int = next(Timers._IDS)
 
     @contextmanager
     def __call__(self, name: str):
@@ -37,16 +66,28 @@ class Timers:
             dt = time.perf_counter() - t0
             self.acc[path] = self.acc.get(path, 0.0) + dt
             self.count[path] = self.count.get(path, 0) + 1
+            _emit_span(path, dt, tim=self.trace_id)
 
     def add(self, name: str, seconds: float, count: int = 1) -> None:
         """Fold an externally-measured duration into the registry at
         the current nesting path.  The grouped chunk pipeline
         (parallel/groups._pipeline_chunks) measures its
         upload/compute/download/writeback segments on a local Timers
-        and absorbs them into the driver's reporting instance here."""
+        and absorbs them into the driver's reporting instance here.
+
+        Called OUTSIDE any active ``with tim(...)`` scope, the segment
+        is tagged *external* (it was measured by another component, not
+        timed here): ``report()`` renders it with an ``[absorbed]``
+        marker instead of passing it off as a phase of this registry,
+        and the emitted span carries ``ext=True``."""
+        ext = not self._stack
         path = "/".join([p for p, _ in self._stack] + [name])
+        if ext:
+            self.external.add(path)
         self.acc[path] = self.acc.get(path, 0.0) + float(seconds)
         self.count[path] = self.count.get(path, 0) + int(count)
+        _emit_span(path, float(seconds), count=int(count),
+                   tim=self.trace_id, ext=ext)
 
     def report(self, min_s: float = 0.0) -> str:
         lines = []
@@ -54,6 +95,7 @@ class Timers:
             if self.acc[k] < min_s:
                 continue
             depth = k.count("/")
+            mark = "  [absorbed]" if k in self.external else ""
             lines.append(f"{'  ' * depth}{k.split('/')[-1]:28s} "
-                         f"{self.acc[k]:9.3f}s  x{self.count[k]}")
+                         f"{self.acc[k]:9.3f}s  x{self.count[k]}{mark}")
         return "\n".join(lines)

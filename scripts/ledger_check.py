@@ -9,12 +9,13 @@ reintroduces per-iteration recompiles (exact static shapes, a fresh
 jit object per call, an unbucketed budget) trips this gate without
 anyone having to eyeball BENCH artifacts.
 
-``--diff old.json new.json`` instead compares two ledger artifacts
-(plain snapshots, bench JSON with extra.compile_ledger, or the BENCH_r*
-wrapper with parsed.extra.compile_ledger) and exits 1 when any shared
-entry point's compiled-variant count GREW — the bench-side regression
-check bench.py / scripts/scale_big.py run against the previous round's
-artifact.
+``--diff old.json new.json`` instead runs the cross-artifact regression
+differ (obs/artifact.py ``artifact_diff``): both sides are upgraded to
+the canonical schema, then compile-ledger variant growth (the historical
+hard-fail class), headline-metric drops, qmin/qmean drops, scheduler
+saved-dispatch shrinkage and disappearing metric counters are reported.
+Exit 1 on ledger regressions; ``--strict`` also fails on metric/quality
+regressions.
 """
 from __future__ import annotations
 
@@ -25,30 +26,40 @@ import sys
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 
-def diff_main(old_path: str, new_path: str) -> int:
-    from parmmg_tpu.utils.compilecache import (extract_artifact_ledger,
-                                               ledger_diff)
+def diff_main(old_path: str, new_path: str, strict: bool = False) -> int:
+    from parmmg_tpu.obs.artifact import artifact_diff
     with open(old_path) as f:
-        old = extract_artifact_ledger(json.load(f))
+        old = json.load(f)
     with open(new_path) as f:
-        new = extract_artifact_ledger(json.load(f))
-    bad = ledger_diff(old, new)
+        new = json.load(f)
+    d = artifact_diff(old, new)
+    for label, rows in (("LEDGER VARIANT REGRESSIONS", d["ledger"]),
+                        ("METRIC REGRESSIONS", d["value"]),
+                        ("QUALITY REGRESSIONS", d["quality"]),
+                        ("notes", d["notes"])):
+        if rows:
+            print(f"{label}:", file=sys.stderr)
+            for v in rows:
+                print(f"  {v}", file=sys.stderr)
+    bad = list(d["ledger"])
+    if strict:
+        bad += d["value"] + d["quality"]
     if bad:
-        print("LEDGER VARIANT REGRESSIONS:", file=sys.stderr)
-        for v in bad:
-            print(f"  {v}", file=sys.stderr)
         return 1
-    print(f"ledger diff OK: no entry point grew its variant count "
-          f"({old_path} -> {new_path})")
+    print(f"artifact diff OK: no ledger"
+          + ("" if not strict else "/metric/quality")
+          + f" regressions ({old_path} -> {new_path})")
     return 0
 
 
 if len(sys.argv) >= 2 and sys.argv[1] == "--diff":
-    if len(sys.argv) != 4:
-        print("usage: ledger_check.py --diff OLD.json NEW.json",
-              file=sys.stderr)
+    args = [a for a in sys.argv[2:] if a != "--strict"]
+    if len(args) != 2:
+        print("usage: ledger_check.py --diff [--strict] OLD.json "
+              "NEW.json", file=sys.stderr)
         sys.exit(2)
-    sys.exit(diff_main(sys.argv[2], sys.argv[3]))
+    sys.exit(diff_main(args[0], args[1],
+                       strict="--strict" in sys.argv[2:]))
 
 os.environ["JAX_PLATFORMS"] = "cpu"
 # the virtual multi-device CPU mesh (same setup as tests/conftest.py):

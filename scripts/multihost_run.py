@@ -97,7 +97,12 @@ def worker() -> None:
     }
     say(f"[p{pid}] done: {json.dumps(res)}")
     if pid == 0:
-        print(json.dumps(res))
+        # canonical schema-versioned artifact (obs/artifact.py) — the
+        # legacy result dict rides in extra
+        from parmmg_tpu.obs.artifact import make_artifact
+        print(json.dumps(make_artifact(
+            "MULTIHOST", metric="multihost_adapt",
+            value=res["seconds"], unit="s", extra=res)))
     log.close()
 
 

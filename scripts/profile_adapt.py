@@ -3,6 +3,21 @@
 Times each sub-operator (edge table, lengths, split, adjacency, collapse,
 swaps, smooth) with block_until_ready, after a compile warm-up, to show
 where an adapt cycle's time goes.  Run: python scripts/profile_adapt.py [N]
+
+**Device-timeline capture** (ROADMAP item 1d / 4 prerequisite — the
+one-pass profile recipe, TPU-ready, runnable today on the CPU backend):
+
+    PARMMG_PROFILE_DIR=/tmp/prof python scripts/profile_adapt.py 16
+
+arms ``jax.profiler.start_trace`` over the timed section via the obs
+capture-window machinery (obs/trace.py) — every ``timeit`` label lands
+on the profiler timeline as a ``TraceAnnotation``, and the grouped
+paths' ``named_scope`` phase names annotate the XLA ops, so the
+TensorBoard/xprof view carries the SAME phase vocabulary as the host
+trace JSONL.  The same env knob arms a capture around outer pass
+``PARMMG_PROFILE_PASS=start[:stop]`` of any grouped/distributed run
+(driver, bench, scale_big workers) — this script is just the smallest
+recipe that produces a timeline.
 """
 from __future__ import annotations
 
@@ -21,6 +36,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from parmmg_tpu.core.mesh import make_mesh
+from parmmg_tpu.obs import trace as otrace
 from parmmg_tpu.ops import adjacency as adj
 from parmmg_tpu.ops.adapt import adapt_cycle
 from parmmg_tpu.ops.analysis import analyze_mesh
@@ -38,10 +54,13 @@ def timeit(label, fn, *args, reps=3, **kw):
     jax.block_until_ready(out)          # compile + warm
     ts = []
     for _ in range(reps):
-        t0 = time.perf_counter()
-        out = jfn(*args)
-        jax.block_until_ready(out)
-        ts.append(time.perf_counter() - t0)
+        # annotate: the label shows on the profiler's device timeline
+        # when a capture is armed (free nullcontext otherwise)
+        with otrace.annotate(label):
+            t0 = time.perf_counter()
+            out = jfn(*args)
+            jax.block_until_ready(out)
+            ts.append(time.perf_counter() - t0)
     print(f"  {label:28s} {min(ts)*1e3:9.2f} ms")
     return out
 
@@ -56,6 +75,12 @@ def main():
         jnp.asarray(h, mesh.vert.dtype)).at[len(h):].set(1.0)
     print(f"N={n}: {len(tet)} tets, capT={mesh.capT}, capP={mesh.capP}, "
           f"device={jax.devices()[0].platform}")
+
+    # capture window: with PARMMG_PROFILE_DIR set this arms the
+    # profiler over the timed section below (treated as "pass 0" — the
+    # default PARMMG_PROFILE_PASS window); warm-up compiles above this
+    # line stay OUT of the capture so the timeline shows steady state
+    otrace.profile_pass_begin(0)
 
     # NOTE: every prep value is produced by a jitted call — eager array
     # code on the tunneled backend pays a transport round trip PER OP
@@ -80,13 +105,16 @@ def main():
             m = jax.tree.map(jnp.copy, m1)
             k = jnp.copy(k1)
             jax.block_until_ready(k)
-            t0 = time.perf_counter()
-            m, k, c = adapt_cycle(m, k, jnp.asarray(1, jnp.int32),
-                                  do_swap=do_swap)
-            np.asarray(c)
-            dt = time.perf_counter() - t0
+            with otrace.annotate(f"adapt_cycle_swap{int(do_swap)}"):
+                t0 = time.perf_counter()
+                m, k, c = adapt_cycle(m, k, jnp.asarray(1, jnp.int32),
+                                      do_swap=do_swap)
+                np.asarray(c)
+                dt = time.perf_counter() - t0
         print(f"  adapt_cycle(do_swap={do_swap!s:5}) "
               f"{dt*1e3:9.2f} ms  counts={np.asarray(c)[:5]}")
+
+    otrace.profile_pass_end(0)
 
 
 if __name__ == "__main__":

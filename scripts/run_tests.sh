@@ -25,6 +25,15 @@ if [ "${1:-}" = "--ledger" ]; then
     exec env JAX_PLATFORMS=cpu python scripts/ledger_check.py
 fi
 
+# --obs: observability gate (scripts/obs_check.py) — a tiny grouped
+# pass with PARMMG_TRACE armed must replay to the same per-phase totals
+# Timers.report prints (the spans ARE the timer measurements), and
+# trace-on vs trace-off must add ZERO groups.* compile families
+# (telemetry is host bookkeeping, never a new program).
+if [ "${1:-}" = "--obs" ]; then
+    exec env JAX_PLATFORMS=cpu python scripts/obs_check.py
+fi
+
 fail=0
 for f in tests/test_*.py; do
     echo "=== $f"

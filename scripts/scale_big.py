@@ -326,11 +326,14 @@ def main():
         for r in regressions:
             print(f"scale:   {r}", file=sys.stderr)
 
-    print(json.dumps({
-        "metric": "grouped_scale_throughput",
-        "value": round(rate, 4),
-        "unit": "Mtets/sec/chip (incl. one-time compile)",
-        "extra": {
+    # canonical schema-versioned artifact (obs/artifact.py)
+    from parmmg_tpu.obs.artifact import make_artifact
+    print(json.dumps(make_artifact(
+        "SCALE",
+        metric="grouped_scale_throughput",
+        value=round(rate, 4),
+        unit="Mtets/sec/chip (incl. one-time compile)",
+        extra={
             "niter": niter,
             "ntets_initial": int(ntet0),
             "ntets_final": int(tm.sum()),
@@ -355,8 +358,7 @@ def main():
             # fresh compiles once the persistent cache is warm
             "compile_ledger": ledger,
             "ledger_regressions": regressions,
-        },
-    }))
+        })))
 
 
 def _ledger_regressions_vs_previous(ledger: dict) -> list[str]:

@@ -159,13 +159,17 @@ def main() -> int:
             "qmean": (rep["tenants"][tid]["quality"] or {}).get("qmean"),
             "ntets": (rep["tenants"][tid]["quality"] or {}).get("ntets"),
             "ops": rep["tenants"][tid]["ops"],
+            "slo": rep["tenants"][tid].get("slo"),
         } for tid, name in tenants}
 
-    doc = {
-        "metric": "serve_throughput",
-        "value": round(rep["served"] / max(serve_s, 1e-9), 3),
-        "unit": "meshes/sec (warm pool, CPU backend)",
-        "extra": {
+    # canonical schema-versioned artifact (obs/artifact.py)
+    from parmmg_tpu.obs.artifact import make_artifact
+    doc = make_artifact(
+        "SERVE",
+        metric="serve_throughput",
+        value=round(rep["served"] / max(serve_s, 1e-9), 3),
+        unit="meshes/sec (warm pool, CPU backend)",
+        extra={
             "tenants": ntenants,
             "served": rep["served"],
             "rejected": rep["rejected"],
@@ -193,8 +197,7 @@ def main() -> int:
             "ledger_regressions_vs_artifact": cross,
             "compile_ledger": ledger,
             "device": jax.default_backend(),
-        },
-    }
+        })
     line = json.dumps(doc)
     print(line)
 
