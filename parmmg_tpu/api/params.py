@@ -116,6 +116,9 @@ class Info:
     fmtout: str = "mesh"
     centralized_output: bool = True
     noout: bool = False
+    # resilience (resilience/checkpoint.py): resume the grouped outer
+    # loop from the newest PARMMG_CKPT_DIR pass checkpoint (-resume)
+    resume: bool = False
     # devices
     n_devices: int = 1
 

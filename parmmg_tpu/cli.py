@@ -77,6 +77,9 @@ def build_parser() -> argparse.ArgumentParser:
     a("-rn", type=int, default=-1, help="(renumbering: n/a on TPU)")
     a("-centralized-output", dest="cent_out", action="store_true")
     a("-distributed-output", dest="dist_out", action="store_true")
+    a("-resume", action="store_true",
+      help="resume a killed grouped run from the newest "
+           "PARMMG_CKPT_DIR pass checkpoint (resilience/checkpoint.py)")
     a("-val", action="store_true", help="print default values and exit")
     a("-bench-json", dest="bench_json", action="store_true",
       help="print one JSON line with timing/quality stats")
@@ -259,6 +262,7 @@ def main(argv=None) -> int:
     info.mem_budget_mb = args.mem
     info.centralized_output = not args.dist_out
     info.noout = args.noout
+    info.resume = args.resume
 
     # local-parameter file (<mesh>.mmg3d, MMG3D_parsop format; the
     # reference delegates parsing to Mmg at libparmmg_tools.c:573)

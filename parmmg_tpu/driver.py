@@ -136,6 +136,7 @@ def parmmg_run(pm) -> tuple[Mesh, object, AdaptStats]:
     from .utils.timers import Timers
     from .api.params import check_input_data
     from .obs import trace as otrace
+    from .resilience.recover import RetryBudgetExhausted, ladder_step
     info = pm.info
     check_input_data(info, met_is_aniso=(
         pm.met is not None and getattr(pm.met, "ndim", 1) == 2))
@@ -213,11 +214,22 @@ def parmmg_run(pm) -> tuple[Mesh, object, AdaptStats]:
                         else 0, stats=stats,
                         noinsert=info.noinsert, noswap=info.noswap,
                         nomove=info.nomove, hausd=hausd,
-                        ifc_layers=info.ifc_layers, timers=tim)
+                        ifc_layers=info.ifc_layers, timers=tim,
+                        resume=getattr(info, "resume", False))
             except MemoryError:
                 mesh, met = backup
                 stats.status = C.PMMG_LOWFAILURE
                 degraded = True
+                ladder_step("lowfailure", site="groups.capacity")
+            except RetryBudgetExhausted as e:
+                # the retry rung of the ladder is spent (chunk dispatch
+                # or polish worker kept failing): restore the conforming
+                # backup and degrade — never die holding user data
+                mesh, met = backup
+                stats.status = C.PMMG_LOWFAILURE
+                degraded = True
+                ladder_step("lowfailure", site=e.site,
+                            detail=str(e.__cause__ or e))
             except Exception as e:  # device OOM = XlaRuntimeError
                 if "RESOURCE_EXHAUSTED" not in str(e) and \
                         "Out of memory" not in str(e):
@@ -225,6 +237,8 @@ def parmmg_run(pm) -> tuple[Mesh, object, AdaptStats]:
                 mesh, met = backup
                 stats.status = C.PMMG_LOWFAILURE
                 degraded = True
+                ladder_step("lowfailure", site="device.oom",
+                            detail=str(e)[:200])
             # bad-element polish on the merged mesh (the same contract as
             # the other two paths — group seams breed slivers)
             if not degraded and not (info.noinsert and info.noswap
@@ -264,6 +278,7 @@ def parmmg_run(pm) -> tuple[Mesh, object, AdaptStats]:
                 # libparmmg1.c:974-1011)
                 mesh, met = backup
                 stats.status = C.PMMG_LOWFAILURE
+                ladder_step("lowfailure", site="adapt.capacity")
                 break
             except Exception as e:  # device OOM comes as XlaRuntimeError
                 if "RESOURCE_EXHAUSTED" not in str(e) and \
@@ -271,6 +286,8 @@ def parmmg_run(pm) -> tuple[Mesh, object, AdaptStats]:
                     raise
                 mesh, met = backup
                 stats.status = C.PMMG_LOWFAILURE
+                ladder_step("lowfailure", site="device.oom",
+                            detail=str(e)[:200])
                 break
             stats += st
     else:
@@ -317,6 +334,7 @@ def parmmg_run(pm) -> tuple[Mesh, object, AdaptStats]:
             # (failed_handling, libparmmg1.c:974-1011)
             mesh, met, part = e.mesh, e.met, e.part
             stats.status = C.PMMG_LOWFAILURE
+            ladder_step("lowfailure", site="shard.overflow")
             from .obs.trace import log as _olog
             _olog(C.PMMG_VERB_VERSION,
                   "  ## Warning: shard capacity exhausted; saving the "

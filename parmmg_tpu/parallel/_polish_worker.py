@@ -18,7 +18,19 @@ argv[2] = output .npz (updated tet-axis leaves + met).  Invoked by
 from __future__ import annotations
 
 import dataclasses
+import os
 import sys
+
+# injected worker crash (resilience/faults.py "polish.worker" site): the
+# parent decided the firing and forced it through PARMMG_FAULT_FORCE;
+# exit non-zero BEFORE the heavy jax import so the injected failure is
+# cheap while still exercising the parent's real rc!=0 recovery path.
+# Guarded on __main__ so merely importing this module never exits.
+if __name__ == "__main__" and \
+        os.environ.get("PARMMG_FAULT_FORCE", "") == "polish.worker":
+    print("injected fault: polish.worker (PARMMG_FAULT_FORCE)",
+          file=sys.stderr, flush=True)
+    raise SystemExit(3)
 
 import numpy as np
 

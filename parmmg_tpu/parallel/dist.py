@@ -286,6 +286,12 @@ def refresh_shard_analysis_device(stacked: Mesh, comms, n_shards: int,
     import os
     if os.environ.get("PARMMG_HOST_ANALYSIS", "") == "1":
         return None
+    # injectable KS-overflow (resilience/faults.py): the real failure
+    # here is a flag, not an exception — firing takes the exact branch
+    # a shared-record budget overflow takes (None -> host fallback)
+    from ..resilience.faults import fault_trigger, faultpoint
+    if fault_trigger("analysis.ks_overflow"):
+        return None
     from .analysis_dev import dist_analysis, dist_analysis_grouped
     from .comms import packed_halo_rows
     glo_np = np.stack([np.asarray(g) for g in glo])
@@ -315,12 +321,41 @@ def refresh_shard_analysis_device(stacked: Mesh, comms, n_shards: int,
                 dist_analysis(dmesh, angedg, KS))
         if cache is not None:
             cache[key] = fn
-    vt, et, ovf = fn(
-        stacked,
-        shard_stacked(jnp.asarray(glo_np.astype(np.int32)), dmesh),
-        shard_stacked(jnp.asarray(comms.node_idx), dmesh),
-        shard_stacked(jnp.asarray(comms.nbr), dmesh))
-    if int(ovf) != 0:
+    args = (stacked,
+            shard_stacked(jnp.asarray(glo_np.astype(np.int32)), dmesh),
+            shard_stacked(jnp.asarray(comms.node_idx), dmesh),
+            shard_stacked(jnp.asarray(comms.nbr), dmesh))
+    try:
+        if Mp is not None:
+            faultpoint("halo.exchange")
+        vt, et, ovf = fn(*args)
+        # sync INSIDE the guard: device dispatch is async, so a real
+        # crash of the packed program surfaces at this first host pull,
+        # not at the fn() call — outside the try it would bypass the
+        # dense fallback entirely
+        ovf_host = int(ovf)
+    except Exception as e:
+        if Mp is None:
+            raise
+        # packed halo program failed (injectable via
+        # PARMMG_FAULT=halo.exchange): retry once on the DENSE layout —
+        # ladder step "halo_dense".  Same governed program family
+        # (dist.analysis_grouped), dense variant; the hysteresis state
+        # is left alone so a healthy next iteration can re-pick packed.
+        from ..resilience.recover import ladder_step
+        ladder_step("halo_dense", site="halo.exchange", detail=repr(e))
+        dkey = (angedg, KS, n_shards, G, None)
+        if cache is not None and dkey in cache:
+            fn = cache[dkey]
+        else:
+            fn = governed("dist.analysis_grouped", budget=2)(
+                dist_analysis_grouped(dmesh, angedg, KS, G,
+                                      packed_M=None))
+            if cache is not None:
+                cache[dkey] = fn
+        vt, et, ovf = fn(*args)
+        ovf_host = int(ovf)
+    if ovf_host != 0:
         return None
     return dataclasses.replace(stacked, vtag=vt, etag=et)
 
@@ -656,6 +691,8 @@ def distributed_adapt(mesh: Mesh, met, n_shards: int,
     if st2 is not None:
         stacked = st2
     else:
+        from ..resilience.recover import ladder_step
+        ladder_step("host_analysis", site="analysis.ks_overflow")
         stacked = refresh_shard_analysis(stacked, comms, n_shards, ang_,
                                          glo=glo_)
     merged, met_m, part_new = merge_shards(stacked, met_s,
@@ -893,12 +930,18 @@ def distributed_adapt_multi(mesh: Mesh, met, n_shards: int,
         if st2 is not None:
             stacked = st2
         else:
-            # host fallback (shared-record budget overflow)
             if multi:
+                # no ladder event here: the fallback is NOT taken on
+                # the multi-process path — recording host_analysis and
+                # then dying would log a recovery that never happened
                 raise NotImplementedError(
                     "analysis host fallback needs a full-view pull — "
                     "not distributed; raise the KS budget or run "
                     "single-process")
+            # host fallback (shared-record budget overflow) — the
+            # "host_analysis" escalation-ladder rung
+            from ..resilience.recover import ladder_step
+            ladder_step("host_analysis", site="analysis.ks_overflow")
             views = pull_views(stacked, met_s)
             stacked = refresh_shard_analysis(
                 stacked, comms, n_shards, ang, glo=glo, views=views)

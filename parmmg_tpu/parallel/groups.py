@@ -212,7 +212,7 @@ def _pad_groups(tree, g_new: int):
     return jax.tree.map(pad, tree)
 
 
-def _pipeline_chunks(fn, stacked, met_s, wave, plans, tim):
+def _pipeline_chunks(fn, stacked, met_s, wave, plans, tim, done=None):
     """Double-buffered chunked dispatch over gathered group-index slices.
 
     ``plans``: [(idx_exec [chunk], nreal)] from the quiet-group
@@ -242,12 +242,37 @@ def _pipeline_chunks(fn, stacked, met_s, wave, plans, tim):
     tuned against the HBM ceiling (the 16 GB-chip OOM note below) may
     need the legacy memory bound back rather than a smaller chunk.
 
+    Fault tolerance (resilience/): a chunk whose dispatch or drain
+    fails (the tunnel's mid-session crash mode; injectable via
+    ``PARMMG_FAULT=dispatch.chunk``) is re-run SERIALLY under the
+    retry/backoff wrapper.  This is exact, not best-effort: the host
+    state is only mutated by a drain's writeback (its last step, and
+    idempotent), so a failed chunk's inputs are intact and a
+    re-dispatch from them is bit-identical.  Retry-budget exhaustion
+    raises ``RetryBudgetExhausted`` — the driver's LOWFAILURE signal.
+    ``done`` (optional dict) records each plan's counts as its drain
+    COMMITS (i.e. after writeback): a caller catching the exhaustion
+    can tell exactly which plans already mutated the host state and
+    which never ran — the serve pool's isolation fallback needs that
+    to avoid re-applying a wave to already-advanced slots.
+
     Returns the per-plan host count arrays (trimmed to nreal), in plan
     order."""
     import os
+    from ..resilience.faults import faultpoint
+    from ..resilience.recover import retry_call
     depth = 2 if os.environ.get("PARMMG_GROUP_PIPELINE", "1") != "0" \
         else 1
     out = [None] * len(plans)
+
+    def dispatch(pi, idx, nreal):
+        with tim("upload"):
+            sl = jax.tree.map(lambda a: jnp.asarray(a[idx]), stacked)
+            kl = jnp.asarray(met_s[idx])
+        faultpoint("dispatch.chunk", key=str(pi))
+        with otrace.annotate(f"grp_dispatch_chunk{pi}"):
+            m, k, cnt = fn(sl, kl, wave)
+        return (pi, idx, nreal, m, k, cnt)
 
     def drain(p):
         pi, idx, nreal, m, k, cnt = p
@@ -265,22 +290,39 @@ def _pipeline_chunks(fn, stacked, met_s, wave, plans, tim):
                 return d
             jax.tree.map(w, stacked, mh)
             met_s[rows] = kh[:nreal]
+        if done is not None:
+            done[pi] = out[pi]
+
+    def redo(pi, idx, nreal, first):
+        # serial dispatch+drain re-attempt of one failed chunk; the
+        # inline fast-path attempt already counted (initial_failure)
+        retry_call(lambda: drain(dispatch(pi, idx, nreal)),
+                   site="dispatch.chunk", initial_failure=first)
+
+    def safe_drain(p):
+        try:
+            drain(p)
+        except Exception as e:
+            redo(p[0], p[1], p[2], e)
 
     pending = None
     for pi, (idx, nreal) in enumerate(plans):
-        with tim("upload"):
-            sl = jax.tree.map(lambda a: jnp.asarray(a[idx]), stacked)
-            kl = jnp.asarray(met_s[idx])
-        with otrace.annotate(f"grp_dispatch_chunk{pi}"):
-            m, k, cnt = fn(sl, kl, wave)
+        cur = first = None
+        try:
+            cur = dispatch(pi, idx, nreal)
+        except Exception as e:
+            first = e
         if pending is not None:
-            drain(pending)
-        pending = (pi, idx, nreal, m, k, cnt)
-        if depth == 1:
-            drain(pending)
-            pending = None
+            p0, pending = pending, None
+            safe_drain(p0)
+        if cur is None:
+            redo(pi, idx, nreal, first)
+        elif depth == 1:
+            safe_drain(cur)
+        else:
+            pending = cur
     if pending is not None:
-        drain(pending)
+        safe_drain(pending)
     return out
 
 
@@ -290,7 +332,8 @@ def grouped_adapt_pass(mesh: Mesh, met, ngroups: int, cycles: int = 12,
                        noinsert: bool = False, noswap: bool = False,
                        nomove: bool = False, hausd: float | None = None,
                        polish: bool = False, cap_mult: float = 3.0,
-                       timers=None):
+                       timers=None, ckpt_tag: str | None = None,
+                       ckpt_it: int = 0):
     """One outer pass: split into groups, run adapt cycles with lax.map
     over the group axis, merge.  Returns (mesh, met, part_of_merged).
 
@@ -465,31 +508,56 @@ def grouped_adapt_pass(mesh: Mesh, met, ngroups: int, cycles: int = 12,
             # fresh-process polish (see _polish_worker module docstring:
             # the tunnel worker reliably dies when this program lands
             # late in a long session; a fresh client runs it fine).
-            # Non-fatal: on worker failure the grouped polish is
-            # skipped with a warning — the caller's merged polish +
-            # repair tail still runs.
+            # Worker failure (rc != 0 — the real tunnel-crash shape,
+            # injectable via PARMMG_FAULT=polish.worker) is a ladder
+            # path: retry with backoff in a fresh process first (the
+            # invocation is idempotent from in.npz), then degrade one
+            # rung — grouped polish skipped, the caller's merged polish
+            # + repair tail still covers the quality tail.  The temp
+            # .npz staging (multi-GB at the 1M-tet scale) is removed in
+            # a finally: a crashed worker or an unwinding retry must
+            # not leak it in /tmp.
+            import shutil
             import subprocess
             import sys as _sys
             import tempfile
             from ..core.mesh import MESH_FIELDS
-            with tempfile.TemporaryDirectory() as td:
+            from ..obs.metrics import REGISTRY
+            from ..resilience.faults import subprocess_fault_env
+            from ..resilience.recover import (RetryBudgetExhausted,
+                                              WorkerExitError,
+                                              ladder_step, retry_call)
+            td = tempfile.mkdtemp(prefix="parmmg_polish_")
+            try:
                 inp, outp = f"{td}/in.npz", f"{td}/out.npz"
                 np.savez(inp, met=met_s, chunk=chunk,
                          noinsert=noinsert, noswap=noswap, nomove=nomove,
                          hausd=(np.nan if hausd is None else hausd),
                          **{f: getattr(stacked, f) for f in MESH_FIELDS})
                 import os as _os
-                env = dict(_os.environ)
+                env0 = dict(_os.environ)
                 pkg_parent = _os.path.dirname(_os.path.dirname(
                     _os.path.dirname(_os.path.abspath(__file__))))
-                env["PYTHONPATH"] = (env.get("PYTHONPATH", "") +
-                                     _os.pathsep + pkg_parent).lstrip(
+                env0["PYTHONPATH"] = (env0.get("PYTHONPATH", "") +
+                                      _os.pathsep + pkg_parent).lstrip(
                     _os.pathsep)
-                r = subprocess.run(
-                    [_sys.executable, "-m",
-                     "parmmg_tpu.parallel._polish_worker", inp, outp],
-                    stderr=subprocess.PIPE, text=True, env=env)
-                if r.returncode == 0:
+
+                def _invoke():
+                    if _os.path.exists(outp):
+                        _os.unlink(outp)        # stale partial output
+                    env = dict(env0)
+                    env.update(subprocess_fault_env("polish.worker"))
+                    r = subprocess.run(
+                        [_sys.executable, "-m",
+                         "parmmg_tpu.parallel._polish_worker", inp,
+                         outp],
+                        stderr=subprocess.PIPE, text=True, env=env)
+                    if r.returncode != 0:
+                        raise WorkerExitError("polish.worker",
+                                              r.returncode, r.stderr)
+                    return r
+                try:
+                    r = retry_call(_invoke, site="polish.worker")
                     import dataclasses as _dc
                     z = np.load(outp)
                     stacked = _dc.replace(
@@ -497,10 +565,18 @@ def grouped_adapt_pass(mesh: Mesh, met, ngroups: int, cycles: int = 12,
                     met_s = z["met"]
                     if verbose >= 2:
                         print(r.stderr, end="")
-                else:
-                    print("grouped polish worker failed "
-                          f"(rc={r.returncode}); skipping grouped "
-                          "polish\n" + r.stderr[-2000:], file=_sys.stderr)
+                except RetryBudgetExhausted as e:
+                    REGISTRY.counter(
+                        "resilience.polish_worker_failures").inc()
+                    ladder_step("merged_polish", site="polish.worker",
+                                detail=str(e.__cause__ or e))
+                    otrace.log(1, "  ## Warning: grouped polish worker "
+                                  f"failed ({e.__cause__ or e}); "
+                                  "skipping grouped polish — the merged "
+                                  "polish + repair tail still runs.",
+                               err=True)
+            finally:
+                shutil.rmtree(td, ignore_errors=True)
         elif chunk and sched.enabled:
             # quiet-group polish: wave-major over COMPACTED active
             # chunks, retiring each group at its own collapse+swap==0
@@ -518,23 +594,42 @@ def grouped_adapt_pass(mesh: Mesh, met, ngroups: int, cycles: int = 12,
             # case keeps the legacy loop via PARMMG_GROUP_SCHED=0 (the
             # default TPU polish rides the subprocess worker anyway).
             from .sched import chunk_plans
+            from ..resilience.recover import (RetryBudgetExhausted,
+                                              ladder_step)
             pol_act = np.arange(ngroups)
-            for w in range(4):
-                if not len(pol_act):
-                    break
-                plans = chunk_plans(pol_act, chunk)
-                sched.dispatches += len(plans)
-                parts = _pipeline_chunks(
-                    polish_block, stacked, met_s,
-                    jnp.asarray(2000 + w, jnp.int32), plans, ltim)
-                cnts = np.concatenate(parts)          # [n_act, 4]
-                pol_traj.append(len(pol_act))
-                tot = cnts.sum(axis=0, dtype=np.int64)
-                otrace.log(2, f"  grp polish w{w}: collapse "
-                              f"{int(tot[0])} swap {int(tot[1])} move "
-                              f"{int(tot[2])} over {len(pol_act)} "
-                              "active groups", verbose=verbose)
-                pol_act = pol_act[(cnts[:, 0] + cnts[:, 1]) > 0]
+            try:
+                for w in range(4):
+                    if not len(pol_act):
+                        break
+                    plans = chunk_plans(pol_act, chunk)
+                    sched.dispatches += len(plans)
+                    parts = _pipeline_chunks(
+                        polish_block, stacked, met_s,
+                        jnp.asarray(2000 + w, jnp.int32), plans, ltim)
+                    cnts = np.concatenate(parts)      # [n_act, 4]
+                    pol_traj.append(len(pol_act))
+                    tot = cnts.sum(axis=0, dtype=np.int64)
+                    otrace.log(2, f"  grp polish w{w}: collapse "
+                                  f"{int(tot[0])} swap {int(tot[1])} "
+                                  f"move {int(tot[2])} over "
+                                  f"{len(pol_act)} active groups",
+                               verbose=verbose)
+                    pol_act = pol_act[(cnts[:, 0] + cnts[:, 1]) > 0]
+            except RetryBudgetExhausted as e:
+                # polish is a quality tail, not the sizing loop: a
+                # persistent dispatch fault here degrades one rung
+                # (remaining grouped polish skipped — the state is
+                # conforming with or without it; committed chunks keep
+                # their polish) instead of escalating to the driver's
+                # LOWFAILURE, which would throw away the whole adapted
+                # mesh (README ladder: merged_polish)
+                ladder_step("merged_polish", site="dispatch.chunk",
+                            detail=str(e.__cause__ or e))
+                otrace.log(1, "  ## Warning: grouped polish dispatch "
+                              f"kept failing ({e.__cause__ or e}); "
+                              "skipping the remaining grouped polish "
+                              "waves — the merged polish + repair tail "
+                              "still runs.", err=True)
         elif chunk:
             # per-chunk wave loop (PARMMG_GROUP_SCHED=0 legacy): each
             # chunk polishes to ITS quiet point while resident, one
@@ -606,6 +701,13 @@ def grouped_adapt_pass(mesh: Mesh, met, ngroups: int, cycles: int = 12,
     if timers is not None:
         for k, v in ltim.acc.items():
             timers.add(f"grp {k}", v, ltim.count[k])
+    # pass-level durability (resilience/checkpoint.py): the pre-merge
+    # stacked state doubles as the merge-free distributed-file snapshot
+    # of this pass (the reference's -distributed-output checkpoint
+    # role).  ckpt_due-gated: free unless PARMMG_CKPT_DIR is armed.
+    if ckpt_tag is not None:
+        from ..resilience.checkpoint import snapshot_stacked
+        snapshot_stacked(ckpt_tag, ckpt_it, stacked, ngroups)
     if chunk:
         # merge on the CPU backend: merge_shards rebuilds adjacency at
         # MERGED-mesh width — a whole-mesh device program that OOMs the
@@ -621,17 +723,46 @@ def grouped_adapt(mesh: Mesh, met, target_size: int, niter: int = 3,
                   cycles: int = 12, verbose: int = 0, stats=None,
                   noinsert: bool = False, noswap: bool = False,
                   nomove: bool = False, hausd: float | None = None,
-                  ifc_layers: int = 2, timers=None):
+                  ifc_layers: int = 2, timers=None,
+                  resume: bool = False, ckpt_tag: str = "grouped"):
     """The two-level outer loop on one device: grouped passes with
     interface displacement between them (the rank-level loop of
     libparmmg1.c:636-948 collapsed onto one device, groups as the only
     level).  Engaged by the driver when ``-mesh-size`` yields >= 2
-    groups."""
+    groups.
+
+    Durability (resilience/checkpoint.py, PARMMG_CKPT_DIR armed): the
+    merged state + displaced partition are checkpointed after each
+    completed outer pass; ``resume=True`` restarts from the newest
+    complete pass checkpoint instead of from scratch.  Passes are
+    deterministic from their input state, so a resumed run finishes
+    bit-identical to an uninterrupted one (chaos-gated)."""
     from .partition import move_interfaces
     from ..core.mesh import mesh_to_host
+    from ..resilience import checkpoint as ckpt
 
     part = None
-    for it in range(max(1, niter)):
+    it0 = 0
+    # run-identity fingerprint of the ORIGINAL input: stored in every
+    # checkpoint and matched at resume, so a reused PARMMG_CKPT_DIR can
+    # never silently resume a stale checkpoint from a different run
+    fp = None
+    if resume or ckpt.ckpt_config()[0]:
+        fp = ckpt.run_fingerprint(mesh, met, target_size, niter, cycles,
+                                  noinsert, noswap, nomove, hausd,
+                                  ifc_layers)
+    if resume:
+        found = ckpt.latest_pass_checkpoint(ckpt_tag, fingerprint=fp)
+        if found is not None:
+            path, k = found
+            mesh, met, part, _ = ckpt.load_pass_checkpoint(path)
+            it0 = k + 1
+            from ..obs.metrics import REGISTRY
+            REGISTRY.counter("resilience.resumes").inc()
+            otrace.event("ckpt.resumed", tag=ckpt_tag, it=it0, path=path)
+            otrace.log(1, f"  resume: loaded {path}; restarting at "
+                          f"outer pass {it0}", err=True)
+    for it in range(it0, max(1, niter)):
         # profiler capture window (PARMMG_PROFILE_DIR over the
         # PARMMG_PROFILE_PASS outer-pass range — obs/trace.py)
         otrace.profile_pass_begin(it)
@@ -649,16 +780,32 @@ def grouped_adapt(mesh: Mesh, met, target_size: int, niter: int = 3,
                 if stats is not None:
                     stats += st
                 part = None
+                ckpt.save_pass_checkpoint(ckpt_tag, it, mesh, met, part,
+                                          fingerprint=fp)
                 otrace.profile_pass_end(it)
                 continue
             mesh, met, part_m = grouped_adapt_pass(
                 mesh, met, ngroups, cycles=cycles, part=part,
                 verbose=verbose, stats=stats, noinsert=noinsert,
                 noswap=noswap, nomove=nomove, hausd=hausd,
-                timers=timers)
+                timers=timers, ckpt_tag=ckpt_tag, ckpt_it=it)
             if it + 1 < max(1, niter):
                 _, tet_h, _, _, _ = mesh_to_host(mesh)
                 part = move_interfaces(tet_h, part_m, ngroups,
                                        nlayers=ifc_layers)
+                # the checkpoint carries the DISPLACED labels: pass
+                # it+1's exact input, which is what makes resume
+                # bit-identical to the uninterrupted run
+                ckpt.save_pass_checkpoint(ckpt_tag, it, mesh, met, part,
+                                          fingerprint=fp)
+            else:
+                # the FINAL pass checkpoints too (part=None — there is
+                # no next pass to feed): a kill during the caller's
+                # post-adapt tail (merged polish / repair / IO, minutes
+                # at the 1M-tet scale) must not restart the whole
+                # adaptation; resume with it0 == niter skips the loop
+                # and hands the tail this state
+                ckpt.save_pass_checkpoint(ckpt_tag, it, mesh, met,
+                                          None, fingerprint=fp)
         otrace.profile_pass_end(it)
     return mesh, met

@@ -267,6 +267,12 @@ class ServeDriver:
               verbose=self.verbose, err=True)
 
     def _expire_timeouts(self) -> None:
+        """Expire requests past PARMMG_SERVE_TIMEOUT_S.  Reclamation
+        contract for a RUNNING tenant (regression-tested,
+        tests/test_serve.py): ``pool.release`` must scrub the slot row
+        back to the dead-mesh state AND return the slot to the bucket's
+        free list, so the next queued tenant can rent it — a timed-out
+        tenant must never strand capacity."""
         if not self.timeout_s:
             return
         now = time.perf_counter()
@@ -356,6 +362,11 @@ class ServeDriver:
                 "dispatches": self.pool.dispatches,
                 "chunk": self.pool.chunk,
                 "slots_per_bucket": self.pool.slots_per_bucket,
+                # fault-isolation state (resilience ladder, serving
+                # form): tenants retired FAILED after
+                # PARMMG_SERVE_MAX_RETRIES slot faults
+                "quarantined": list(self.pool.quarantined),
+                "max_slot_retries": self.pool.max_slot_retries,
                 "buckets": self.pool.occupancy(),
                 "active_per_step": list(self.pool.active_per_step),
                 "chunk_recommendation": self.pool.chunk_recommendation(),

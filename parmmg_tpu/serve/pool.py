@@ -63,6 +63,7 @@ class Slot:
     regrows: int = 0
     loaded: bool = False
     stats: object = None       # AdaptStats(tenant=...)
+    faults: int = 0            # dispatch faults (quarantine ladder)
 
 
 class Bucket:
@@ -110,9 +111,19 @@ class SlotPool:
                  max_capP: int | None = None, max_capT: int | None = None,
                  cycles: int = 6, noinsert: bool = False,
                  noswap: bool = False, nomove: bool = False,
-                 hausd: float | None = None):
+                 hausd: float | None = None,
+                 max_slot_retries: int | None = None):
         self.slots_per_bucket = slots_per_bucket if slots_per_bucket \
             else _env_int("PARMMG_SERVE_SLOTS", 4)
+        # fault-isolation budget (PARMMG_SERVE_MAX_RETRIES): a tenant
+        # whose slot dispatch faults this many times is quarantined —
+        # retired FAILED, slot scrubbed and recycled — never aborting
+        # cohort-mates sharing the chunk
+        self.max_slot_retries = max(1, max_slot_retries
+                                    if max_slot_retries is not None
+                                    else _env_int(
+                                        "PARMMG_SERVE_MAX_RETRIES", 2))
+        self.quarantined: list[str] = []
         self.chunk = max(1, chunk if chunk
                          else _env_int("PARMMG_SERVE_CHUNK", 1))
         self.cap_mult = float(cap_mult)
@@ -272,6 +283,98 @@ class SlotPool:
         with jax.default_device(cpu):
             return merge_shards(one, jnp.asarray(b.met[i:i + 1]))
 
+    # ---- fault isolation (resilience ladder, serving form) ----------------
+    def _note_slot_fault(self, s: Slot, exc) -> bool:
+        """Account one slot-dispatch fault.  Returns True when the
+        tenant just crossed ``max_slot_retries`` and is quarantined:
+        terminal FAILED, slot scrubbed + recycled at retirement.
+        Below the threshold the slot simply stays at its cycle index
+        and is re-dispatched next step — its state is untouched
+        (writeback only happens on a successful drain), so the retry
+        is exact."""
+        from ..obs import trace as otrace
+        from ..obs.metrics import REGISTRY
+        s.faults += 1
+        REGISTRY.counter("serve.slot_faults").inc()
+        if s.tenant is not None:
+            REGISTRY.counter("serve.slot_faults", tenant=s.tenant).inc()
+        if s.faults >= self.max_slot_retries:
+            s.failed = (f"quarantined after {s.faults} slot fault(s): "
+                        + repr(exc)[:200])
+            self.quarantined.append(s.tenant)
+            REGISTRY.counter("serve.quarantined").inc()
+            otrace.event("serve.quarantine", tenant=s.tenant,
+                         faults=s.faults, detail=repr(exc)[:300])
+            otrace.log(1, f"serve: QUARANTINED {s.tenant} after "
+                          f"{s.faults} slot fault(s)", err=True)
+            return True
+        otrace.event("serve.slot_fault", tenant=s.tenant,
+                     faults=s.faults, detail=repr(exc)[:300])
+        return False
+
+    def _dispatch_cohort(self, b: Bucket, fn, wave, ids, done) -> list:
+        """Dispatch one cohort with per-tenant fault isolation.
+
+        Fast path: one compacted multi-slot dispatch (the packed
+        serving path).  If it faults (a poisoned tenant's dispatch —
+        injectable via ``PARMMG_FAULT=serve.slot_step;key=<tenant>``),
+        fall back to per-slot dispatches so cohort-mates are never
+        aborted by the faulting tenant: a single-slot plan pads to the
+        SAME compiled ``[chunk, ...]`` program (``chunk_plans``) and
+        ``lax.map`` rows are independent, so the mates' results stay
+        bit-identical to the packed dispatch.  Plans whose drain
+        already COMMITTED during the fast path (the ``done`` contract
+        of ``_pipeline_chunks``) keep their results — their slots
+        advanced, and re-dispatching them would apply the cycle wave
+        twice.  Returns [(slot index, counts row [nblk, >=8])] for
+        slots that ran; faulting slots are accounted via
+        :meth:`_note_slot_fault` (retried next step, or quarantined
+        into ``done``)."""
+        from ..obs import trace as otrace
+        from ..obs.metrics import REGISTRY
+        from ..parallel.groups import _pipeline_chunks
+        from ..parallel.sched import chunk_plans
+        from ..resilience.faults import FAULTS, faultpoint
+        plans = chunk_plans(np.asarray(ids), self.chunk)
+        committed: dict = {}
+        try:
+            if FAULTS.armed():
+                for i in ids:
+                    faultpoint("serve.slot_step", key=b.slots[i].tenant)
+            parts = _pipeline_chunks(fn, b.stacked, b.met, wave, plans,
+                                     self.timers, done=committed)
+            self.dispatches += len(plans)
+            REGISTRY.counter("serve.dispatches").inc(len(plans))
+            return list(zip(ids, np.concatenate(parts)))
+        except Exception as e:
+            REGISTRY.counter("resilience.serve_cohort_faults").inc()
+            otrace.event("serve.cohort_fault", detail=repr(e)[:300])
+        out = []
+        for pi, (idx, nreal) in enumerate(plans):
+            rows = [int(v) for v in idx[:nreal]]
+            if pi in committed:
+                # this plan's drain COMMITTED during the fast path (its
+                # writeback advanced the slots): honor its results —
+                # re-dispatching would apply the cycle wave twice
+                self.dispatches += 1
+                REGISTRY.counter("serve.dispatches").inc()
+                out.extend(zip(rows, committed[pi]))
+                continue
+            for i in rows:
+                s = b.slots[i]
+                try:
+                    faultpoint("serve.slot_step", key=s.tenant)
+                    plans1 = chunk_plans(np.asarray([i]), self.chunk)
+                    parts1 = _pipeline_chunks(fn, b.stacked, b.met,
+                                              wave, plans1, self.timers)
+                    self.dispatches += len(plans1)
+                    REGISTRY.counter("serve.dispatches").inc(len(plans1))
+                    out.append((i, np.concatenate(parts1)[0]))
+                except Exception as e:
+                    if self._note_slot_fault(s, e):
+                        done.append(s.tenant)
+        return out
+
     # ---- the serving step -------------------------------------------------
     def _grow_tenant(self, tenant: str) -> None:
         """Promote an overflowed tenant to the (2*capP, 2*capT) bucket
@@ -366,9 +469,8 @@ class SlotPool:
         from ..obs import trace as otrace
         from ..obs.metrics import REGISTRY
         from ..ops.adapt import default_cycle_block
-        from ..parallel.groups import (_group_block, _pipeline_chunks,
-                                       block_converged, block_schedule)
-        from ..parallel.sched import chunk_plans
+        from ..parallel.groups import (_group_block, block_converged,
+                                       block_schedule)
 
         self.steps += 1
         done: list[str] = []
@@ -396,16 +498,11 @@ class SlotPool:
                                              self.noswap)
                 fn = _group_block(flags, pres, self.nomove,
                                   self.noinsert, self.hausd)
-                plans = chunk_plans(np.asarray(ids), self.chunk)
-                self.dispatches += len(plans)
-                REGISTRY.counter("serve.dispatches").inc(len(plans))
-                parts = _pipeline_chunks(fn, b.stacked, b.met,
-                                         jnp.asarray(c, jnp.int32),
-                                         plans, self.timers)
-                counts = np.concatenate(parts)       # [n_act, nblk, 8]
-                for row, i in enumerate(ids):
+                rows = self._dispatch_cohort(
+                    b, fn, jnp.asarray(c, jnp.int32), ids, done)
+                for i, crow in rows:
                     s = b.slots[i]
-                    cs = counts[row].astype(np.int64)    # [nblk, 8]
+                    cs = crow.astype(np.int64)           # [nblk, 8]
                     st = s.stats
                     for ib in range(nblk):
                         st.nsplit += int(cs[ib][0])
@@ -432,7 +529,7 @@ class SlotPool:
                         done.append(s.tenant)
                 otrace.log(2, f"  serve step {self.steps} bucket "
                               f"{key[0]}x{key[1]} c{c}: {len(ids)} "
-                              f"tenants, {len(plans)} dispatches",
+                              f"tenants, {len(rows)} dispatched",
                            verbose=verbose, err=True)
         return done
 

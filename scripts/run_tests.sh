@@ -34,6 +34,18 @@ if [ "${1:-}" = "--obs" ]; then
     exec env JAX_PLATFORMS=cpu python scripts/obs_check.py
 fi
 
+# --chaos: fault-injection gate (scripts/chaos_check.py) — every
+# PARMMG_FAULT site provokes its REAL failure path in-process and must
+# land on its documented escalation-ladder step: recovered bit-for-bit
+# (transient dispatch fault, checkpoint/resume) or degraded with a
+# conforming mesh (retry exhaustion -> LOWFAILURE, worker death ->
+# merged polish, serve quarantine with cohort parity).  The zero-fault
+# run with the resilience wiring active must be bit-neutral and add
+# ZERO new groups.* compile families.
+if [ "${1:-}" = "--chaos" ]; then
+    exec env JAX_PLATFORMS=cpu python scripts/chaos_check.py
+fi
+
 fail=0
 for f in tests/test_*.py; do
     echo "=== $f"

@@ -23,6 +23,16 @@ Knobs: SCALE_N (default 56 -> 6*56^3 = 1,053,696 tets),
        SCALE_TARGET (group size target, default 24576),
        SCALE_CYCLES (default 6), SCALE_NITER (passes, default 2),
        SCALE_DEVICE=cpu to keep even the workers off the chip.
+
+Resume (``--resume`` / SCALE_RESUME=1): the per-pass ``state<k>.npz``
+hand-over files under SCALE_TMP double as pass checkpoints — each gets
+a ``.ok`` marker only once it is a COMPLETE pass input (state0 after
+staging, state<k> after the displacement rewrite), so a kill mid-pass
+or mid-write can never leave a marked-but-corrupt state.  A resumed
+run restarts from the newest marked state and, passes being
+deterministic functions of their input state, finishes bit-identical
+to an uninterrupted run (the resilience/checkpoint.py contract; the
+in-process half is chaos-gated by scripts/chaos_check.py).
 """
 from __future__ import annotations
 
@@ -57,6 +67,22 @@ def _load_state(path):
     z = np.load(path)
     mesh = Mesh(**{f: z[f] for f in MESH_FIELDS})
     return z, mesh, z["met"], z["part"]
+
+
+def _mark_ready(path: str) -> None:
+    """Completion marker: ``path`` is a complete pass-input state."""
+    with open(path + ".ok", "w") as f:
+        f.write("ok\n")
+
+
+def _find_resume(tmp: str, niter: int) -> int | None:
+    """Newest pass index k whose state<k>.npz is marked complete."""
+    best = None
+    for k in range(niter + 1):
+        p = f"{tmp}/state{k}.npz"
+        if os.path.exists(p) and os.path.exists(p + ".ok"):
+            best = k
+    return best
 
 
 def worker() -> None:
@@ -153,55 +179,112 @@ def main():
     target = int(os.environ.get("SCALE_TARGET", "24576"))
     niter = max(1, int(os.environ.get("SCALE_NITER", "2")))
 
-    phases = {}
-    t0 = time.perf_counter()
-    vert, tet = cube_mesh(n)
-    ntet0 = len(tet)
-    phases["host_build"] = time.perf_counter() - t0
-
-    t0 = time.perf_counter()
-    # host partition: morton only — fix_contiguity's python BFS is an
-    # O(mesh) host stage this datapoint deliberately excludes (group
-    # seams freeze identically either way).  The curve is split by
-    # PREDICTED-final-density weights, not initial counts: the shock
-    # slab grows ~6x while coarse regions shrink, so equal-initial
-    # groups overflow their static caps exactly where the work is (the
-    # regrow then forces a fresh remote compile, which is what kills
-    # the tunnel worker — see the module docstring).  A tet of volume
-    # V in a region with target size h ends as ~V/(h^3/(6 sqrt 2))
-    # unit tets; the bisection equilibrium overshoots the ideal count
-    # ~2.2x (measured, bench fixture class).  weight = 1 + predicted
-    # bounds BOTH the initial and the final group size by the group's
-    # weight share, so one static cap fits all groups end to end.
-    h = analytic_iso_metric(vert, "shock", h=1.5 / n)
-    cent = vert[tet].mean(axis=1)
-    p = vert[tet]
-    vol = np.abs(np.einsum(
-        "ij,ij->i", p[:, 1] - p[:, 0],
-        np.cross(p[:, 2] - p[:, 0], p[:, 3] - p[:, 0]))) / 6.0
-    h_tet = np.asarray(h)[tet].mean(axis=1)
-    pred = 2.2 * vol / (0.1178 * np.maximum(h_tet, 1e-9) ** 3)
-    w = 1.0 + pred
-    ngroups = how_many_groups(int(w.sum()), int(1.5 * target))
-    part = morton_partition(cent, ngroups, weights=w)
-    phases["host_partition"] = time.perf_counter() - t0
-
-    t0 = time.perf_counter()
-    mesh = make_mesh(vert, tet, capP=2 * len(vert), capT=2 * len(tet))
-    mesh = analyze_mesh(mesh).mesh
-    met = jnp.zeros(mesh.capP, mesh.vert.dtype).at[: len(h)].set(
-        jnp.asarray(h, mesh.vert.dtype)).at[len(h):].set(1.0)
-    jax.block_until_ready(mesh.vert)
-    phases["stage_analyze"] = time.perf_counter() - t0
-
-    # ---- grouped passes, one fresh-client subprocess each --------------
     tmp = os.environ.get("SCALE_TMP", "/tmp/parmmg_scale")
     os.makedirs(tmp, exist_ok=True)
+    # --resume / SCALE_RESUME=1: restart from the newest COMPLETE pass
+    # state (``.ok``-marked — see module docstring) instead of from
+    # scratch; the skipped staging metadata rides in meta.json
+    resume = "--resume" in sys.argv[1:] or \
+        os.environ.get("SCALE_RESUME", "") == "1"
+    it0 = 0
+    phases = {}
     state = f"{tmp}/state0.npz"
-    t0 = time.perf_counter()
-    _save_state(state, mesh, met, part)
-    phases["state_io"] = time.perf_counter() - t0
-    del mesh, met
+    # run-identity knobs: stored in meta.json and required to match at
+    # resume — a reused SCALE_TMP must never silently resume a run with
+    # different SCALE_* knobs (a final-pass state in particular carries
+    # an UN-displaced partition, so extending niter on it would break
+    # the bit-identical contract)
+    knobs = {"n": n, "target": target, "niter": niter,
+             "cycles": int(os.environ.get("SCALE_CYCLES", "6"))}
+    if resume:
+        k = _find_resume(tmp, niter)
+        meta_p = f"{tmp}/meta.json"
+        if k is None or not os.path.exists(meta_p):
+            print(f"scale: --resume requested but no complete state "
+                  f"under {tmp}; starting fresh", file=sys.stderr)
+            resume = False
+        else:
+            with open(meta_p) as f:
+                meta = json.load(f)
+            stored = {kk: meta.get(kk) for kk in knobs}
+            if stored != knobs:
+                print("scale: --resume refused: SCALE knobs differ "
+                      f"from the checkpointed run ({stored} vs "
+                      f"{knobs}); starting fresh", file=sys.stderr)
+                resume = False
+            elif k >= niter:
+                # every pass already complete: the original run emitted
+                # its artifact; re-emitting one with zero adapt seconds
+                # would read as a throughput regression in the artifact
+                # differ — nothing to resume, say so and stop
+                print(f"scale: --resume: all {niter} passes already "
+                      f"complete under {tmp}; nothing to resume",
+                      file=sys.stderr)
+                return
+            else:
+                it0 = k
+                ntet0, ngroups = int(meta["ntet0"]), int(meta["ngroups"])
+                state = f"{tmp}/state{k}.npz"
+                print(f"scale: resuming from {state} "
+                      f"(outer pass {k}/{niter})", file=sys.stderr)
+    if not resume:
+        # fresh start: drop stale pass states + markers so a LATER
+        # resume can never mix runs
+        import glob as _glob
+        for f in _glob.glob(f"{tmp}/state*.npz*"):
+            os.remove(f)
+        it0 = 0
+        t0 = time.perf_counter()
+        vert, tet = cube_mesh(n)
+        ntet0 = len(tet)
+        phases["host_build"] = time.perf_counter() - t0
+
+        t0 = time.perf_counter()
+        # host partition: morton only — fix_contiguity's python BFS is
+        # an O(mesh) host stage this datapoint deliberately excludes
+        # (group seams freeze identically either way).  The curve is
+        # split by PREDICTED-final-density weights, not initial counts:
+        # the shock slab grows ~6x while coarse regions shrink, so
+        # equal-initial groups overflow their static caps exactly where
+        # the work is (the regrow then forces a fresh remote compile,
+        # which is what kills the tunnel worker — see the module
+        # docstring).  A tet of volume V in a region with target size h
+        # ends as ~V/(h^3/(6 sqrt 2)) unit tets; the bisection
+        # equilibrium overshoots the ideal count ~2.2x (measured, bench
+        # fixture class).  weight = 1 + predicted bounds BOTH the
+        # initial and the final group size by the group's weight share,
+        # so one static cap fits all groups end to end.
+        h = analytic_iso_metric(vert, "shock", h=1.5 / n)
+        cent = vert[tet].mean(axis=1)
+        p = vert[tet]
+        vol = np.abs(np.einsum(
+            "ij,ij->i", p[:, 1] - p[:, 0],
+            np.cross(p[:, 2] - p[:, 0], p[:, 3] - p[:, 0]))) / 6.0
+        h_tet = np.asarray(h)[tet].mean(axis=1)
+        pred = 2.2 * vol / (0.1178 * np.maximum(h_tet, 1e-9) ** 3)
+        w = 1.0 + pred
+        ngroups = how_many_groups(int(w.sum()), int(1.5 * target))
+        part = morton_partition(cent, ngroups, weights=w)
+        phases["host_partition"] = time.perf_counter() - t0
+
+        t0 = time.perf_counter()
+        mesh = make_mesh(vert, tet,
+                         capP=2 * len(vert), capT=2 * len(tet))
+        mesh = analyze_mesh(mesh).mesh
+        met = jnp.zeros(mesh.capP, mesh.vert.dtype).at[: len(h)].set(
+            jnp.asarray(h, mesh.vert.dtype)).at[len(h):].set(1.0)
+        jax.block_until_ready(mesh.vert)
+        phases["stage_analyze"] = time.perf_counter() - t0
+
+        # ---- grouped passes, one fresh-client subprocess each ----------
+        t0 = time.perf_counter()
+        _save_state(state, mesh, met, part)
+        _mark_ready(state)
+        with open(f"{tmp}/meta.json", "w") as f:
+            json.dump({"ntet0": int(ntet0), "ngroups": int(ngroups),
+                       **knobs}, f)
+        phases["state_io"] = time.perf_counter() - t0
+        del mesh, met
 
     cycles_run = 0
     ops = np.zeros(4, np.int64)
@@ -212,7 +295,7 @@ def main():
     group_disp = 0
     saved_disp = 0
     chunk_rec = 0
-    for it in range(niter):
+    for it in range(it0, niter):
         nxt = f"{tmp}/state{it + 1}.npz"
         env = dict(os.environ)
         env.update(SCALE_IN=state, SCALE_OUT=nxt, SCALE_WORKER="1",
@@ -236,17 +319,27 @@ def main():
             env.pop("JAX_PLATFORMS", None)
         t0 = time.perf_counter()
         # the pass is idempotent from its input state: on a tunnel
-        # worker crash (the UNAVAILABLE failure mode), retry once in a
-        # fresh process before giving up
-        for attempt in range(2):
-            r = subprocess.run([sys.executable, os.path.abspath(__file__)],
-                               env=env)
-            if r.returncode == 0:
-                break
-            print(f"pass {it} worker attempt {attempt} failed "
-                  f"rc={r.returncode}", file=sys.stderr)
-        if r.returncode != 0:
-            raise RuntimeError(f"pass {it} worker failed rc={r.returncode}")
+        # worker crash (the UNAVAILABLE failure mode), retry in a fresh
+        # process through the shared resilience wrapper — same
+        # PARMMG_RETRY_* knobs, backoff, ladder events and counters as
+        # the in-process recovery paths
+        from parmmg_tpu.resilience.recover import (RetryBudgetExhausted,
+                                                   WorkerExitError,
+                                                   retry_call)
+
+        def _invoke_pass():
+            r = subprocess.run(
+                [sys.executable, os.path.abspath(__file__)], env=env)
+            if r.returncode != 0:
+                raise WorkerExitError("scale.worker", r.returncode)
+            return r
+
+        try:
+            retry_call(_invoke_pass, site="scale.worker")
+        except RetryBudgetExhausted as e:
+            raise RuntimeError(
+                f"pass {it} worker failed after retries "
+                f"({e.__cause__ or e})") from e
         phases[f"pass{it}_total"] = time.perf_counter() - t0
         z, mesh2, met2, part_m = _load_state(nxt)
         phases[f"pass{it}_adapt"] = float(z["adapt_s"])
@@ -276,8 +369,15 @@ def main():
             phases["ifc_displacement"] = \
                 phases.get("ifc_displacement", 0.0) + \
                 (time.perf_counter() - t0)
-            # rewrite the state with the displaced partition
+            # rewrite the state with the displaced partition, THEN mark
+            # complete: a kill mid-rewrite resumes from the previous
+            # marked state (re-running one pass, never corrupting one)
             _save_state(state, mesh2, met2, part2)
+            _mark_ready(state)
+        # the FINAL state is marked only after the artifact is emitted
+        # (end of main): a kill during the post-adapt tail must leave
+        # the last pass resumable, or the artifact could never be
+        # produced without a full rerun
 
     # post-merge whole-mesh polish on the CPU backend: the grouped
     # polish cannot touch the FINAL seams (frozen in their own pass);
@@ -335,6 +435,7 @@ def main():
         unit="Mtets/sec/chip (incl. one-time compile)",
         extra={
             "niter": niter,
+            **({"resumed_from_pass": it0} if it0 else {}),
             "ntets_initial": int(ntet0),
             "ntets_final": int(tm.sum()),
             "ngroups": int(ngroups),
@@ -359,6 +460,9 @@ def main():
             "compile_ledger": ledger,
             "ledger_regressions": regressions,
         })))
+    # only now is the run truly complete: mark the final state so a
+    # later --resume knows there is nothing left to produce
+    _mark_ready(state)
 
 
 def _ledger_regressions_vs_previous(ledger: dict) -> list[str]:

@@ -105,6 +105,38 @@ def test_adapt_stats_namespaces_per_tenant_keys():
 # ---------------------------------------------------------------------------
 # PARMMG_GROUP_CHUNK auto-tune (ROADMAP 1b satellite)
 # ---------------------------------------------------------------------------
+def test_timeout_scrubs_and_recycles_slot():
+    """Regression (resilience satellite): a RUNNING request expired by
+    _expire_timeouts must leave its pool slot SCRUBBED (row zeroed back
+    to the dead-mesh state) and back on the bucket's free list, rentable
+    by the next tenant — a timed-out tenant must never strand capacity."""
+    import time
+    from parmmg_tpu.serve.driver import (RUNNING, TIMEOUT, ServeDriver,
+                                         ServeRequest)
+    pool = SlotPool(slots_per_bucket=1)
+    drv = ServeDriver(pool=pool, timeout_s=0.001)
+    st, key, i = pool.admit("a", 27, 48)
+    assert st == "ok"
+    # fake-load the slot host-side (no XLA): a dict pytree stands in
+    # for the stacked Mesh, with non-zero rows to catch the scrub
+    b = pool.buckets[key]
+    b.stacked = {"vert": np.ones((1, 8, 3)), "tet": np.ones((1, 16, 4))}
+    b.met = np.ones((1, 8))
+    b.slots[i].loaded = True
+    drv.requests["a"] = ServeRequest(
+        tid="a", state=RUNNING, t_submit=time.perf_counter() - 10.0)
+    drv._expire_timeouts()
+    r = drv.requests["a"]
+    assert r.state == TIMEOUT and "exceeded" in r.reason
+    # slot scrubbed: row zeroed (born-quiet dead mesh for the next
+    # renter), tenant gone from the rent map, slot back on the free list
+    assert (b.stacked["vert"] == 0).all() and (b.met == 0).all()
+    assert "a" not in pool._where
+    assert b.free_slot() == i
+    # ...and actually rentable by the next tenant
+    assert pool.admit("b", 27, 48) == ("ok", key, i)
+
+
 def test_recommend_group_chunk_tracks_decay():
     from parmmg_tpu.parallel.sched import recommend_group_chunk
     # front-loaded decay: two full blocks then a long quiet tail —
