@@ -1,0 +1,202 @@
+"""Central registry of every ``PARMMG_*`` environment knob.
+
+The env surface grew one knob at a time across the governor, scheduler,
+halo, obs, resilience and serve layers; until this module the only
+inventory was grep.  Every knob the tree reads MUST be declared here —
+``scripts/lint_check.py`` (rule R4) cross-checks the registry against
+the actual ``os.environ`` / ``getenv`` read sites AND against the
+README knob tables, in both directions: an unregistered read fails the
+lint, and so does a registered knob nothing reads (dead knob) or one
+the README never mentions.
+
+This module is import-light on purpose (stdlib only, no jax, no
+numpy): the linter and host-only tests consume it, and the readers in
+the hot layers keep their existing direct ``os.environ`` reads — the
+registry is the *contract*, not a call-path rewrite.
+
+``python -m parmmg_tpu.api.knobs`` prints the canonical markdown table
+(the README "Environment knobs" section is generated from it; R4
+verifies the two never drift).
+
+NOTE for the R4 linter: ``KNOBS`` below must stay a single dict literal
+of ``"NAME": Knob(type, default, doc)`` entries — the linter reads it
+with ``ast`` (no import) so it can run jax-free in <10 s.
+"""
+from __future__ import annotations
+
+import dataclasses
+import os
+
+__all__ = ["KNOBS", "Knob", "get", "knob_table_md", "registered"]
+
+
+@dataclasses.dataclass(frozen=True)
+class Knob:
+    """One env knob: coarse value type ("int" | "float" | "str" |
+    "flag" | "path" | "spec"), the default the reader applies when the
+    variable is unset/empty (as the string the env would carry; "" =
+    off/auto), and a one-line doc."""
+    type: str
+    default: str
+    doc: str
+
+
+KNOBS: dict[str, Knob] = {
+    "PARMMG_BAND_PATH": Knob(
+        "flag", "1",
+        "device band-migration path; 0 = legacy host full-mesh migrate"),
+    "PARMMG_BENCH_FALLBACK": Knob(
+        "flag", "",
+        "bench.py internal: marks a worker run that fell back to "
+        "XLA:CPU so the artifact records fallback=true"),
+    "PARMMG_CKPT_DIR": Knob(
+        "path", "",
+        "pass-checkpoint directory (resilience/checkpoint.py); unset "
+        "= checkpointing off"),
+    "PARMMG_CKPT_EVERY": Knob(
+        "int", "1", "checkpoint every Nth outer pass"),
+    "PARMMG_CYCLE_BLOCK": Knob(
+        "int", "",
+        "override cycles per compiled adapt block (ops/adapt.py); "
+        "empty = backend default"),
+    "PARMMG_FAULT": Knob(
+        "spec", "",
+        "arm fault-injection sites: site[:trigger][,site...] "
+        "(resilience/faults.py grammar)"),
+    "PARMMG_FAULT_FORCE": Knob(
+        "str", "",
+        "internal parent->subprocess forcing of one fault site (the "
+        "polish worker exits pre-jax on it); never set by hand"),
+    "PARMMG_GROUP_CHUNK": Knob(
+        "int", "",
+        "groups per dispatch on the grouped path (0 = one lax.map; "
+        "auto = adopt sched.recommend_group_chunk; empty = backend "
+        "default, 8 on TPU)"),
+    "PARMMG_GROUP_PIPELINE": Knob(
+        "flag", "1",
+        "double-buffer the chunk dispatches; 0 = serialize (one chunk "
+        "in flight)"),
+    "PARMMG_GROUP_SCHED": Knob(
+        "flag", "1",
+        "quiet-group scheduler on the grouped adapt path; 0 = legacy "
+        "always-dispatch"),
+    "PARMMG_HALO_PACK_HYST": Knob(
+        "float", "0.05",
+        "hysteresis margin around the packed-halo occupancy threshold "
+        "(layout flips only past threshold +/- margin)"),
+    "PARMMG_HALO_PACK_OCC": Knob(
+        "float", "0.75",
+        "measured-occupancy threshold under which the grouped halo "
+        "uses the packed per-device-pair layout instead of dense"),
+    "PARMMG_HOST_ANALYSIS": Knob(
+        "flag", "",
+        "1 = skip the device analysis-refresh path and always use the "
+        "host fallback"),
+    "PARMMG_NARROW_DIV": Knob(
+        "int", "",
+        "narrow-row budget divisor override (ops/active.py); empty = "
+        "tuned default"),
+    "PARMMG_POLISH_SUBPROC": Knob(
+        "flag", "",
+        "grouped polish phase in a subprocess worker (the TPU-tunnel "
+        "path); empty = only on the tpu backend"),
+    "PARMMG_PROFILE_DIR": Knob(
+        "path", "",
+        "arm a jax.profiler capture writing the xprof timeline into "
+        "this directory"),
+    "PARMMG_PROFILE_PASS": Knob(
+        "spec", "0",
+        "outer-pass capture window start[:stop] for "
+        "PARMMG_PROFILE_DIR"),
+    "PARMMG_RETRY_BASE_S": Knob(
+        "float", "0.05",
+        "retry backoff base seconds, doubled per attempt"),
+    "PARMMG_RETRY_DEADLINE_S": Knob(
+        "float", "0",
+        "wall-clock cap on retrying (0 = no deadline)"),
+    "PARMMG_RETRY_MAX": Knob(
+        "int", "2",
+        "retries after the first failure on retry_call sites (0 = "
+        "fail fast)"),
+    "PARMMG_SERVE_CHUNK": Knob(
+        "int", "1", "serve pool: tenants per packed cohort dispatch"),
+    "PARMMG_SERVE_MAX_CAPP": Knob(
+        "int", "4194304",
+        "serve admission ceiling on the vertex capacity (oversize "
+        "requests rejected)"),
+    "PARMMG_SERVE_MAX_CAPT": Knob(
+        "int", "4194304",
+        "serve admission ceiling on the tet capacity"),
+    "PARMMG_SERVE_MAX_INFLIGHT": Knob(
+        "int", "0",
+        "serve driver: max requests admitted concurrently (0 = "
+        "unbounded)"),
+    "PARMMG_SERVE_MAX_RETRIES": Knob(
+        "int", "2",
+        "slot faults before a serve tenant is quarantined (retired "
+        "FAILED, slot scrubbed)"),
+    "PARMMG_SERVE_SLO_QMIN": Knob(
+        "float", "0",
+        "per-tenant qmin SLO floor; retirement records an slo_ok / "
+        "slo_violation verdict (0 = off)"),
+    "PARMMG_SERVE_SLOTS": Knob(
+        "int", "4", "serve pool: slots per capacity bucket"),
+    "PARMMG_SERVE_TIMEOUT_S": Knob(
+        "float", "0",
+        "serve driver: per-request wall-clock timeout; the slot is "
+        "reclaimed (0 = off)"),
+    "PARMMG_TEST_CACHE": Knob(
+        "flag", "",
+        "1 = opt the test processes into the persistent compile cache "
+        "(tests/conftest.py; default off — the XLA:CPU AOT cache is "
+        "unreliable on this image)"),
+    "PARMMG_TPU_PALLAS": Knob(
+        "flag", "",
+        "1 = force the Pallas TPU kernels (interpret mode off-TPU); "
+        "0 = disable even on TPU"),
+    "PARMMG_TRACE": Knob(
+        "path", "",
+        "append structured trace records (JSONL) to this file; unset "
+        "= ring buffer only"),
+    "PARMMG_TRACE_RING": Knob(
+        "int", "4096", "trace ring-buffer capacity in records"),
+    "PARMMG_VERBOSE": Knob(
+        "int", "1",
+        "process verbosity (the reference's imprim scale) gating "
+        "obs.trace.log output"),
+}
+
+
+def registered() -> tuple[str, ...]:
+    """All declared knob names, sorted."""
+    return tuple(sorted(KNOBS))
+
+
+def get(name: str, default: str | None = None) -> str:
+    """Registry-checked ``os.environ.get``: raises ``KeyError`` on an
+    undeclared knob so ad-hoc env surface cannot creep back in; falls
+    back to the declared default when no override is given."""
+    if name not in KNOBS:
+        raise KeyError(f"undeclared PARMMG knob {name!r} — declare it "
+                       "in parmmg_tpu/api/knobs.py")
+    return os.environ.get(
+        name, KNOBS[name].default if default is None else default)
+
+
+def knob_table_md() -> str:
+    """The canonical markdown knob table (README 'Environment knobs'
+    section body; R4 verifies every registered name appears in README)."""
+    rows = ["| knob | type | default | purpose |",
+            "|---|---|---|---|"]
+    for name in registered():
+        k = KNOBS[name]
+        rows.append(f"| `{name}` | {k.type} | "
+                    f"{('`' + k.default + '`') if k.default else 'unset'}"
+                    f" | {k.doc} |")
+    return "\n".join(rows)
+
+
+if __name__ == "__main__":
+    # lint: ok(R3) — the table dump IS this module's stdout contract
+    # (README generation channel)
+    print(knob_table_md())

@@ -578,9 +578,9 @@ class ParMesh:
         try:
             out, met, stats = parmmg_run(self)
         except InputError as e:
-            if self.info.imprim >= 0:
-                import sys
-                print(f"  ## Error: {e}.", file=sys.stderr)
+            from ..obs import trace as otrace
+            otrace.log(0, f"  ## Error: {e}.",
+                       verbose=self.info.imprim, err=True)
             return C.PMMG_STRONGFAILURE
         except MemoryError:
             return C.PMMG_STRONGFAILURE

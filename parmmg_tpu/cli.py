@@ -19,6 +19,7 @@ import numpy as np
 
 from .api import ParMesh, IParam, DParam
 from .core import constants as C
+from .obs import trace as otrace
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -98,11 +99,17 @@ def default_values() -> str:
 
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
+    # the CLI's -v flag IS the process imprim: align obs.trace.log's
+    # gate with it up front so pre-run errors/warnings follow the flag
+    # (not a stray PARMMG_VERBOSE inherited from the environment);
+    # fatal diagnostics are level 0, silenced only by an explicit
+    # negative -v — the reference's imprim semantics
+    otrace.set_verbosity(args.verbose)
     if args.val:
-        print(default_values())
+        print(default_values())   # lint: ok(R3) — -val stdout contract
         return 0
     if not args.inp:
-        print("missing -in <mesh>", file=sys.stderr)
+        otrace.log(0, "missing -in <mesh>", err=True)
         return 1
     # persistent compile cache (compile governor): the adapt programs
     # take minutes to compile cold and are identical across runs —
@@ -130,7 +137,7 @@ def main(argv=None) -> int:
         # the metric unless -sol overrides
         from .io.vtk import read_vtu_medit
         if not inp.exists():
-            print(f"cannot open {inp}", file=sys.stderr)
+            otrace.log(0, f"cannot open {inp}", err=True)
             return 1
         m, vtu_met, vtu_fields = read_vtu_medit(inp)
         distributed_in = False
@@ -156,7 +163,7 @@ def main(argv=None) -> int:
     elif inp.exists():
         m = medit.read_mesh(inp)
     else:
-        print(f"cannot open {inp}", file=sys.stderr)
+        otrace.log(0, f"cannot open {inp}", err=True)
         return 1
 
     pm.set_mesh_size(np_=len(m.vert), ne=len(m.tetra), nt=len(m.tria),
@@ -223,8 +230,9 @@ def main(argv=None) -> int:
                 carried.append(a)
                 types.append(SOL_TENSOR)
             else:
-                print(f"warning: dropping VTU point field '{nm}' "
-                      f"({a.shape[1]} components)", file=sys.stderr)
+                otrace.log(0, f"warning: dropping VTU point field "
+                              f"'{nm}' ({a.shape[1]} components)",
+                           err=True)
         if carried:
             pm.set_sols_at_vertices_size(len(types), types)
             for i, chunk in enumerate(carried):
@@ -273,27 +281,27 @@ def main(argv=None) -> int:
         except (IndexError, ValueError) as e:
             # the file is discovered implicitly by name — a stale or
             # malformed one must not abort the run
-            print(f"  ## Warning: unable to parse {parfile} ({e}); "
-                  "local parameters ignored.", file=sys.stderr)
+            otrace.log(0, f"  ## Warning: unable to parse {parfile} "
+                          f"({e}); local parameters ignored.", err=True)
             parsed = []
         for typ, ref, hmin_l, hmax_l, hausd_l in parsed:
             pm.set_local_parameter(typ, ref, hmin_l, hmax_l, hausd_l)
-        if args.verbose >= 1:
-            print(f"  %% {parfile} read: "
-                  f"{len(pm.info.local_params)} local parameter(s)")
+        otrace.log(1, f"  %% {parfile} read: "
+                      f"{len(pm.info.local_params)} local parameter(s)",
+                   verbose=args.verbose)
 
     ret = pm.run()
     dt = time.perf_counter() - t0
     if ret == C.PMMG_LOWFAILURE:
         # a conforming mesh was produced despite the partial failure —
         # save it and exit nonzero (the reference CLI's LOWFAILURE path)
-        print("adaptation INCOMPLETE (low failure): saving the last "
-              "conforming mesh", file=sys.stderr)
+        otrace.log(0, "adaptation INCOMPLETE (low failure): saving "
+                      "the last conforming mesh", err=True)
         if not args.noout:
             _save_outputs(pm, args)
         return ret
     if ret != C.PMMG_SUCCESS:
-        print(f"adaptation FAILED ({ret})", file=sys.stderr)
+        otrace.log(0, f"adaptation FAILED ({ret})", err=True)
         return ret
 
     if args.verbose >= C.PMMG_VERB_QUAL or args.bench_json:
@@ -330,9 +338,9 @@ def _parse_parfile(path):
                 tok = lines[i + 2 + j].split()
                 typ = typ_map.get(tok[1].lower())
                 if typ is None:
-                    print(f"  ## Warning: unsupported local-parameter "
-                          f"type '{tok[1]}' in {path}; entry skipped.",
-                          file=sys.stderr)
+                    otrace.log(0, "  ## Warning: unsupported local-"
+                                  f"parameter type '{tok[1]}' in "
+                                  f"{path}; entry skipped.", err=True)
                     continue
                 out.append((typ, int(tok[0]),
                             float(tok[2]), float(tok[3]), float(tok[4])))
@@ -486,12 +494,14 @@ def _report(pm, dt, as_json):
         "wall_s": round(dt, 3),
     }
     if as_json:
+        # lint: ok(R3) — -bench-json stdout contract (machine-readable
+        # record consumed by bench tooling; must not be gated)
         print(json.dumps(rec))
     else:
-        print(f"  #tets {rec['ntets']}  quality min {rec['qmin']:.4f} "
-              f"mean {rec['qmean']:.4f}  "
-              f"ops s/c/w {rec['nsplit']}/{rec['ncollapse']}/{rec['nswap']}"
-              f"  {rec['wall_s']}s")
+        otrace.log(0, f"  #tets {rec['ntets']}  quality min "
+                      f"{rec['qmin']:.4f} mean {rec['qmean']:.4f}  "
+                      f"ops s/c/w {rec['nsplit']}/{rec['ncollapse']}"
+                      f"/{rec['nswap']}  {rec['wall_s']}s")
 
 
 def _save_outputs(pm, args):

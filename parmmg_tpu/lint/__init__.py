@@ -1,0 +1,17 @@
+"""Static invariant linter (rules R1-R6).
+
+Pure-stdlib ``ast`` checks for the project's load-bearing invariants —
+compile hygiene (R1/R5), the zero-host-pull hot path (R2), obs routing
+(R3), the PARMMG_* knob registry (R4) and static telemetry names (R6)
+— so a violation class the runtime gates (``--ledger``/``--obs``/
+``--chaos``) would need minutes of XLA:CPU compile to catch fails in
+seconds at lint time, before review.  ``scripts/lint_check.py`` is the
+CLI; ``run_tests.sh --lint`` the gate; ``lint_baseline.json`` the
+grandfathered burn-down list.  Importing this package never imports
+jax (enforced by lint_check's own self-check and tests/test_lint.py).
+"""
+from . import rules_compile, rules_hostsync, rules_knobs, rules_obs  # noqa: F401,E501  (register rules)
+from .engine import (RULES, RULE_TITLES, GateResult, LintReport,  # noqa: F401
+                     SourceFile, Violation, baseline_payload,
+                     collect_files, format_report, gate,
+                     load_baseline, run_lint)

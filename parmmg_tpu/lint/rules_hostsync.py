@@ -1,0 +1,168 @@
+"""R2 host-sync: the PR-4 "zero O(mesh) host pulls on the grouped
+path" contract, statically.
+
+A lightweight call-graph reachability pass over ``parmmg_tpu/parallel/``:
+starting from the hot-path roots (the grouped pass + its chunk
+pipeline, the device analysis refresh, and the per-pass distributed
+cycle loop), follow simple-name call edges between functions defined in
+the package and flag every host-synchronising primitive in a reachable
+function:
+
+- ``jax.device_get`` / ``device_get``
+- ``.item()`` / ``.block_until_ready()`` method calls
+- ``np.asarray`` / ``np.array`` / ``np.stack`` on device values
+- ``float(x)`` / ``int(x)`` where ``x`` is a subscript or a call
+  result (the traced-scalar pull idiom ``int(counts[g])``)
+
+The graph is name-based and over-approximate on purpose: a false
+positive costs one reasoned suppression or a baseline entry; a false
+negative is a silent O(mesh) pull multiplying under the chip campaigns.
+Functions that ARE the documented host fallback (the KS-overflow
+ladder) carry a def-line suppression — R2 honours a suppression on the
+violating line, the line above, or the enclosing ``def`` line, so one
+annotation exempts a whole fallback function with its reason attached.
+"""
+from __future__ import annotations
+
+import ast
+
+from .engine import Violation, dotted, rule, walk_scoped
+
+#: reachability roots — the grouped/dist hot paths (PR-4/PR-5 contract)
+ROOTS = (
+    "grouped_adapt_pass",
+    "_pipeline_chunks",
+    "refresh_shard_analysis_device",
+    "dist_analysis_grouped",
+    "run_adapt_cycles",
+)
+
+_SYNC_CALLS = {"jax.device_get": "jax.device_get",
+               "device_get": "jax.device_get",
+               "np.asarray": "np.asarray",
+               "np.array": "np.array",
+               "np.stack": "np.stack",
+               "numpy.asarray": "np.asarray"}
+_SYNC_METHODS = {"item": ".item()",
+                 "block_until_ready": ".block_until_ready()"}
+_CAST_FNS = ("float", "int")
+
+_SCOPE = ("parmmg_tpu/parallel/",)
+
+# float()/int() args that can never be a traced-value sync: env reads
+# and other obviously-host producers
+_HOST_FUNCS = ("environ.get", "os.getenv", "getenv", "len", "round",
+               "time.perf_counter", "time.time")
+
+
+def _host_only_arg(arg) -> bool:
+    if isinstance(arg, ast.Call):
+        from .engine import dotted as _d
+        d = _d(arg.func)
+        return any(d == h or d.endswith("." + h) for h in _HOST_FUNCS)
+    return False
+
+
+def _functions(ctx):
+    """{simple name: [(SourceFile, qualname, node)]} for every def in
+    scope (nested defs included — the dispatch/drain closures are where
+    the pulls live)."""
+    idx: dict[str, list] = {}
+    for sf in ctx.iter(_SCOPE):
+        if sf.tree is None:
+            continue
+        for node, qn, _funcs in walk_scoped(sf.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                idx.setdefault(node.name, []).append((sf, qn, node))
+    return idx
+
+
+def _called_names(fn_node) -> set:
+    """Simple callee names referenced inside a function: direct Name
+    calls, terminal attribute calls (``sched.chunk_plans``), and bare
+    Name references (callbacks passed around, e.g.
+    ``_pipeline_chunks(fn, ...)`` receiving ``dispatch``)."""
+    out = set()
+    for n in ast.walk(fn_node):
+        if isinstance(n, ast.Call):
+            if isinstance(n.func, ast.Name):
+                out.add(n.func.id)
+            elif isinstance(n.func, ast.Attribute):
+                out.add(n.func.attr)
+        elif isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load):
+            out.add(n.id)
+    return out
+
+
+def _reachable(idx) -> dict:
+    """{id(fn_node): (SourceFile, qualname, node)} reachable from ROOTS
+    via simple-name edges."""
+    seen: dict[int, tuple] = {}
+    work = []
+    for r in ROOTS:
+        for ent in idx.get(r, ()):
+            if id(ent[2]) not in seen:
+                seen[id(ent[2])] = ent
+                work.append(ent)
+    while work:
+        _sf, _qn, node = work.pop()
+        for name in _called_names(node):
+            for ent in idx.get(name, ()):
+                if id(ent[2]) not in seen:
+                    seen[id(ent[2])] = ent
+                    work.append(ent)
+    return seen
+
+
+@rule("R2")
+def check_r2(ctx) -> list:
+    idx = _functions(ctx)
+    reach = _reachable(idx)
+    out = []
+    for sf, qn, fn_node in reach.values():
+        qn_full = f"{qn}.{fn_node.name}" if qn != "<module>" \
+            else fn_node.name
+        # direct body only: nested defs are separate graph nodes, so a
+        # pull inside `dispatch` is attributed to `dispatch`, not to
+        # every enclosing scope
+        own_nested = [x for x in ast.walk(fn_node)
+                      if isinstance(x, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef))
+                      and x is not fn_node]
+        skip = set()
+        for nf in own_nested:
+            for x in ast.walk(nf):
+                skip.add(id(x))
+        # suppression anchors: the def line, and for decorated
+        # functions the FIRST decorator's line — a standalone
+        # '# lint: ok(R2)' comment above the decorator resolves to
+        # that line (next non-comment), not to the def
+        def_lines = (fn_node.lineno,) + (
+            (fn_node.decorator_list[0].lineno,)
+            if fn_node.decorator_list else ())
+        for n in ast.walk(fn_node):
+            if id(n) in skip or not isinstance(n, ast.Call):
+                continue
+            tag = None
+            d = dotted(n.func)
+            if d in _SYNC_CALLS:
+                tag = _SYNC_CALLS[d]
+            elif isinstance(n.func, ast.Attribute) \
+                    and n.func.attr in _SYNC_METHODS:
+                tag = _SYNC_METHODS[n.func.attr]
+            elif isinstance(n.func, ast.Name) \
+                    and n.func.id in _CAST_FNS and len(n.args) == 1 \
+                    and isinstance(n.args[0], (ast.Subscript, ast.Call)) \
+                    and not _host_only_arg(n.args[0]):
+                tag = f"{n.func.id}()"
+            if tag is None:
+                continue
+            # def_lines ride along as anchor_lines so the ENGINE
+            # resolves a def-line suppression (whole-function fallback
+            # exemption) and the pair still lands in report.suppressed
+            out.append(Violation(
+                "R2", sf.rel, n.lineno, qn_full, tag,
+                f"host-sync {tag} reachable from the grouped/dist hot "
+                f"path (roots: {', '.join(ROOTS)})",
+                anchor_lines=def_lines))
+    return out

@@ -26,6 +26,7 @@ import numpy as np
 
 from ..core.mesh import Mesh, with_capacity, compact
 from ..core.constants import LLONG, LSHRT
+from ..obs import trace as otrace
 from .adjacency import build_adjacency
 from .split import split_wave
 from .collapse import collapse_wave
@@ -511,9 +512,9 @@ def adapt_mesh(mesh: Mesh, met: jax.Array, max_cycles: int = 50,
             stats.nswap += nw
             stats.nmoved += nm
             stats.cycles += 1
-            if verbose >= 3:
-                print(f"  cycle {cycle:3d}: split {ns:6d} "
-                      f"collapse {nc:6d} swap {nw:6d} move {nm:6d}")
+            otrace.log(3, f"  cycle {cycle:3d}: split {ns:6d} "
+                          f"collapse {nc:6d} swap {nw:6d} move {nm:6d}",
+                       verbose=verbose)
             cycle += 1
             if ovf:
                 # a capacity-truncated cycle cannot witness convergence
@@ -570,9 +571,8 @@ def adapt_mesh(mesh: Mesh, met: jax.Array, max_cycles: int = 50,
         stats.ncollapse += nc
         stats.nswap += nw
         stats.nmoved += nm
-        if verbose >= 3:
-            print(f"  polish {w}: collapse {nc:5d} swap {nw:5d} "
-                  f"move {nm:5d}")
+        otrace.log(3, f"  polish {w}: collapse {nc:5d} swap {nw:5d} "
+                      f"move {nm:5d}", verbose=verbose)
         if nc == 0 and nw == 0:
             break
     return mesh, met, stats

@@ -469,7 +469,8 @@ def packed_halo_rows(nbr: np.ndarray, G: int,
     # signal the BENCH/SCALE metrics block surfaces (obs/metrics.py)
     from ..obs.metrics import REGISTRY
     layout = "packed" if M is not None else "dense"
-    REGISTRY.counter(f"halo.layout_{layout}").inc()
+    REGISTRY.counter("halo.layout_packed" if M is not None
+                     else "halo.layout_dense").inc()
     if state is not None:
         prev = state.get("layout")
         if prev is not None and prev != layout:

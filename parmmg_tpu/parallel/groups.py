@@ -107,6 +107,8 @@ def block_schedule(c0: int, nblk: int, cycles: int, noswap: bool):
     return flags, pres
 
 
+# lint: ok(R2) — cs is host numpy (the per-block counters the drain
+# already pulled); the early-exit decision is pure host bookkeeping
 def block_converged(cs: np.ndarray, flags: tuple, noswap: bool) -> bool:
     """The grouped loop's early-exit rule on a block's summed counts
     ``cs`` [nblk, >=3]: any swap-inclusive cycle posting zero
@@ -274,6 +276,10 @@ def _pipeline_chunks(fn, stacked, met_s, wave, plans, tim, done=None):
             m, k, cnt = fn(sl, kl, wave)
         return (pi, idx, nreal, m, k, cnt)
 
+    # lint: ok(R2) — the pipeline's ONE designed sync point: chunked
+    # mode keeps the pass state host-resident, so the drain downloads
+    # O(chunk) tables + [chunk,nblk,8] counters while chunk k+1 is
+    # already dispatched (PR-5 double buffering; segments timed)
     def drain(p):
         pi, idx, nreal, m, k, cnt = p
         with tim("compute"):
@@ -563,8 +569,11 @@ def grouped_adapt_pass(mesh: Mesh, met, ngroups: int, cycles: int = 12,
                     stacked = _dc.replace(
                         stacked, **{f: z[f] for f in MESH_FIELDS})
                     met_s = z["met"]
-                    if verbose >= 2:
-                        print(r.stderr, end="")
+                    if r.stderr:
+                        # relay the worker's stderr protocol lines
+                        # through the one gated print path
+                        otrace.log(2, r.stderr.rstrip("\n"),
+                                   verbose=verbose)
                 except RetryBudgetExhausted as e:
                     REGISTRY.counter(
                         "resilience.polish_worker_failures").inc()
@@ -685,6 +694,8 @@ def grouped_adapt_pass(mesh: Mesh, met, ngroups: int, cycles: int = 12,
         sched.skipped_group_blocks)
     REGISTRY.gauge("groups.chunk_recommendation").set(chunk_rec)
     for k, v in ltim.acc.items():
+        # lint: ok(R6) — k ranges over the fixed _pipeline_chunks
+        # segment set (upload/compute/download/writeback): bounded
         REGISTRY.counter(f"groups.pipeline.{k}_s").inc(v)
     if stats is not None:
         stats.group_dispatches += sched.dispatches

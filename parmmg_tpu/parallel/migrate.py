@@ -52,6 +52,7 @@ import jax
 import jax.numpy as jnp
 
 from ..core.mesh import Mesh
+from ..obs import trace as otrace
 from ..core.constants import (
     IDIR, IARE, FACE_EDGES, MG_BDY, MG_REQ, MG_NOSURF, MG_PARBDY,
     MG_PARBDYBDY, PARBDY_TAGS)
@@ -553,9 +554,8 @@ def migrate_shards(stacked: Mesh, met_s, views: ShardViews,
     # ---------- E. one sparse push to the device -------------------------
     stacked, met_s = _push_updates(stacked, met_s, views, upd_v, upd_t,
                                    mask_dirty, tag_updates, S)
-    if verbose >= 2:
-        print(f"  migration: moved {nmoved} tets across "
-              f"{len(moves)} shard pairs")
+    otrace.log(2, f"  migration: moved {nmoved} tets across "
+                  f"{len(moves)} shard pairs", verbose=verbose)
     return stacked, met_s, comms, nmoved
 
 
@@ -768,8 +768,9 @@ def weld_shard_bands(stacked: Mesh, views: ShardViews,
                 jnp.asarray(views.tet[s][chg]))
         tmask_d = tmask_d.at[s].set(jnp.asarray(views.tmask[s]))
         vmask_d = vmask_d.at[s].set(jnp.asarray(views.vmask[s]))
-    if ntot and verbose >= 2:
-        print(f"  band weld: {ntot} near-duplicate pairs contracted")
+    if ntot:
+        otrace.log(2, f"  band weld: {ntot} near-duplicate pairs "
+                      "contracted", verbose=verbose)
     if ntot == 0:
         return stacked, 0
     return dataclasses.replace(stacked, tet=tet_d, tmask=tmask_d,

@@ -42,6 +42,7 @@ import numpy as np
 
 from .multihost import pull_host as _pull
 from ..core.mesh import Mesh
+from ..obs import trace as otrace
 from ..core.constants import IDIR
 from ..utils.compilecache import bucket, governed
 
@@ -398,6 +399,8 @@ def retag_device(stacked: Mesh, glo_d, ifc_slots, ifc_vrows):
         mark = jnp.zeros((capT, 6), bool)
         for f in range(4):
             for j in range(3):
+                # lint: ok(R2) — FACE_EDGES is a static host table;
+                # constant fold at trace time, no device sync
                 e = int(FACE_EDGES[f, j])
                 mark = mark.at[:, e].set(
                     mark[:, e] | slot_ifc_s[:, f])
@@ -592,7 +595,8 @@ def band_migrate_iteration(stacked: Mesh, met_s, glo_d,
                      "new_v<=free_v", "arrivals<=free_t")
             parts = _pull(info["ok_parts"])
             bad = [n for n, p in zip(names, parts) if not p]
-            print(f"  band migrate overflow: {bad}")
+            otrace.log(1, f"  band migrate overflow: {bad}",
+                       verbose=verbose)
         return None         # fallback: caller re-runs the full path
     if nmoved == 0:
         return stacked2, met2, glo_d2, None, shared_prev, 0, None
@@ -710,10 +714,10 @@ def band_migrate_iteration(stacked: Mesh, met_s, glo_d,
         vrows_d[s, :len(ifc_vert_rows[s])] = ifc_vert_rows[s]
     stacked2 = retag_device(stacked2, glo_d2, jnp.asarray(slots_d),
                             jnp.asarray(vrows_d))
-    if verbose >= 2:
-        print(f"  band migration: moved {nmoved} tets, "
-              f"{len(iA)} interface faces, {int(shared.sum())} shared "
-              "vertices (device path)")
+    otrace.log(2, f"  band migration: moved {nmoved} tets, "
+                  f"{len(iA)} interface faces, "
+                  f"{int(shared.sum())} shared vertices "
+                  "(device path)", verbose=verbose)
     return (stacked2, met2, glo_d2, comms, shared_now, nmoved,
             _pull(info["arr_slots"]))
 
@@ -796,8 +800,8 @@ def band_weld(stacked: Mesh, met_s, glo_d, glo: list[np.ndarray],
             glo_d_out = glo_d_out.at[s, jnp.asarray(dead_v)].set(-1)
     if ntot == 0:
         return stacked, glo_d_out, 0
-    if verbose >= 2:
-        print(f"  band weld: {ntot} near-duplicate pairs contracted")
+    otrace.log(2, f"  band weld: {ntot} near-duplicate pairs "
+                  "contracted", verbose=verbose)
     out = dataclasses.replace(stacked, tet=tet_d, tmask=tmask_d,
                               vmask=vmask_d)
     return out, glo_d_out, ntot
@@ -991,9 +995,8 @@ def repair_flood_labels(stacked: Mesh, labels_d, depth_d, n_shards: int,
             nfixed += int(fixed_s.sum())
     if nfixed == 0:
         return labels_d, 0
-    if verbose >= 2:
-        print(f"  flood repair: relabeled {nfixed} band tets "
-              "(contiguity/reachability)")
+    otrace.log(2, f"  flood repair: relabeled {nfixed} band tets "
+                  "(contiguity/reachability)", verbose=verbose)
     labels_d = _apply_label_fixes(labels_d, jnp.asarray(rows),
                                   jnp.asarray(new_lab))
     return labels_d, nfixed
@@ -1141,7 +1144,7 @@ def graph_repartition_labels_band(stacked: Mesh, comms, n_shards: int,
                                 elem_w=cw.reshape(-1).astype(float),
                                 npasses=5)
     nmv = int((new_part != init).sum())
-    if verbose >= 2:
-        print(f"  graph band labels: {nmv}/{nclu} clusters reassigned")
+    otrace.log(2, f"  graph band labels: {nmv}/{nclu} clusters "
+                  "reassigned", verbose=verbose)
     return _labels_from_parts(jnp.asarray(clus), stacked.tmask,
                               jnp.asarray(new_part), S)

@@ -9,7 +9,7 @@ unchanged — XLA lowers the 'shard' axis collectives onto ICI within a
 pod slice and DCN across slices.
 
 What runs multi-host today:
-- the SPMD adapt cycles (`dist_adapt_cycle`), quality reductions
+- the SPMD adapt blocks (`dist_adapt_block`), quality reductions
   (`dist_quality`) and the on-device interface echo — their inputs are
   built with :func:`shard_stacked_global`, which feeds each process only
   its addressable shards (``jax.make_array_from_single_device_arrays``);

@@ -480,6 +480,9 @@ class SlotPool:
             # keeps scalar- and tensor-metric buckets of equal caps
             # from colliding on one gauge series
             occ, _nslots = b.occupancy()
+            # lint: ok(R6) — key is a capacity-ladder bucket (geo
+            # ladder from bucket(), capped by PARMMG_SERVE_MAX_CAP*):
+            # O(log cap) distinct series, not unbounded
             REGISTRY.gauge(
                 f"serve.occupancy.{key[0]}x{key[1]}"
                 + (f"m{key[2]}" if key[2] else "")).set(occ)

@@ -63,7 +63,12 @@ class Timers:
             yield
         finally:
             self._stack.pop()
-            dt = time.perf_counter() - t0
+            # round to the ns the trace span carries (emit_span rounds
+            # its record to 9 decimals): accumulator and replayed
+            # stream then agree bit-for-bit even on kernels whose
+            # perf_counter returns sub-ns fractions (the --obs gate's
+            # replay==report contract)
+            dt = round(time.perf_counter() - t0, 9)
             self.acc[path] = self.acc.get(path, 0.0) + dt
             self.count[path] = self.count.get(path, 0) + 1
             _emit_span(path, dt, tim=self.trace_id)
@@ -84,9 +89,11 @@ class Timers:
         path = "/".join([p for p, _ in self._stack] + [name])
         if ext:
             self.external.add(path)
-        self.acc[path] = self.acc.get(path, 0.0) + float(seconds)
+        # same ns rounding as the scope exit: acc == replayed spans
+        seconds = round(float(seconds), 9)
+        self.acc[path] = self.acc.get(path, 0.0) + seconds
         self.count[path] = self.count.get(path, 0) + int(count)
-        _emit_span(path, float(seconds), count=int(count),
+        _emit_span(path, seconds, count=int(count),
                    tim=self.trace_id, ext=ext)
 
     def report(self, min_s: float = 0.0) -> str:

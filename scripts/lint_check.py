@@ -1,0 +1,102 @@
+#!/usr/bin/env python
+"""Static invariant lint gate (``run_tests.sh --lint``).
+
+Runs the R1-R6 AST rules over the tree in a few seconds — no jax
+import, no compiles — and fails on any violation that is neither
+suppressed in source (``# lint: ok(<rule>) — reason``) nor grandfathered
+in ``lint_baseline.json``.  R4 (knob registry) ignores the baseline:
+it must hold exactly, from day one.
+
+Usage:
+    python scripts/lint_check.py                 # the gate
+    python scripts/lint_check.py -v              # + per-rule listings
+    python scripts/lint_check.py --rules R3,R4   # subset
+    python scripts/lint_check.py --baseline-update
+        rewrite lint_baseline.json to the current violation set (an
+        intentional rotation: do this only in the PR that argues why)
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+BASELINE = os.path.join(ROOT, "lint_baseline.json")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--rules", default="",
+                    help="comma-separated rule subset (default: all)")
+    ap.add_argument("--baseline-update", action="store_true",
+                    help="rewrite lint_baseline.json from the current "
+                         "violations (R4 stays unbaselined)")
+    ap.add_argument("-v", "--verbose", action="store_true")
+    args = ap.parse_args(argv)
+
+    t0 = time.perf_counter()
+    from parmmg_tpu import lint
+
+    rules = tuple(r.strip() for r in args.rules.split(",")
+                  if r.strip()) or None
+    try:
+        report = lint.run_lint(ROOT, rules=rules)
+    except ValueError as e:
+        # a typo'd --rules must not read like a lint failure
+        print(f"lint: {e}", file=sys.stderr)
+        return 2
+
+    if args.baseline_update:
+        import json
+        payload = lint.baseline_payload(report)
+        # R4 is never grandfathered — drop its keys so the registry
+        # contract stays exact
+        payload["grandfathered"] = {
+            k: v for k, v in payload["grandfathered"].items()
+            if not k.startswith("R4:")}
+        with open(BASELINE, "w", encoding="utf-8") as f:
+            json.dump(payload, f, indent=1, sort_keys=True)
+            f.write("\n")
+        print(f"lint: baseline rewritten with "
+              f"{len(payload['grandfathered'])} grandfathered keys "
+              f"-> {BASELINE}")
+        return 0
+
+    baseline = lint.load_baseline(BASELINE)
+    result = lint.gate(report, baseline)
+    print(lint.format_report(report, result))
+
+    if args.verbose:
+        print("\n-- suppressed (reasoned, in-source) --")
+        for v, s in report.suppressed:
+            print(f"{v.rule} {v.path}:{v.line} [{v.scope}] {v.detail}"
+                  f"  # {s.reason}")
+
+    dt = time.perf_counter() - t0
+    print(f"\nlint: {len(result.new)} new, "
+          f"{sum(b['current'] for b in result.burndown.values())} "
+          f"baselined ("
+          f"{sum(b['retired'] for b in result.burndown.values())} "
+          f"retired), {len(report.suppressed)} suppressed, "
+          f"{len(result.bad)} suppression problems  [{dt:.2f}s]")
+
+    # the linter's own contract: static means static — jax must never
+    # have been imported by running it
+    if "jax" in sys.modules:
+        print("lint: INTERNAL ERROR — the linter imported jax",
+              file=sys.stderr)
+        return 2
+    if not result.ok:
+        print("lint: FAIL (fix, suppress with a reason, or argue a "
+              "baseline rotation)", file=sys.stderr)
+        return 1
+    print("lint: OK")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

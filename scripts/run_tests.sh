@@ -10,6 +10,23 @@
 set -u
 cd "$(dirname "$0")/.."
 
+# --lint: static invariant gate (scripts/lint_check.py) — R1-R6 AST
+# rules over the whole tree in seconds, no jax import, no compiles:
+# jit-hygiene, hot-path host-sync, obs print routing, PARMMG_* knob
+# registry, jaxcompat shim discipline, static telemetry names.  Zero
+# unsuppressed non-baselined violations allowed (lint_baseline.json is
+# the grandfathered burn-down list; R4 runs with no baseline at all).
+if [ "${1:-}" = "--lint" ]; then
+    exec python scripts/lint_check.py
+fi
+
+# The compile-heavy gates below pay minutes of XLA:CPU compile — run
+# the seconds-cheap static lint first so hygiene violations fail fast.
+if [ "${1:-}" = "--ledger" ] || [ "${1:-}" = "--obs" ] \
+        || [ "${1:-}" = "--chaos" ]; then
+    python scripts/lint_check.py || exit 1
+fi
+
 # --ledger: compile-governor budget gate only — run the steady-state
 # migration scenario (G=1 AND the grouped G=2 layout, so the grouped
 # analysis/exchange entry points are budget-asserted too), the chunked
@@ -47,6 +64,9 @@ if [ "${1:-}" = "--chaos" ]; then
 fi
 
 fail=0
+# static lint first: costs seconds, fails before any compile is paid
+echo "=== lint (static invariants R1-R6)"
+python scripts/lint_check.py || fail=1
 for f in tests/test_*.py; do
     echo "=== $f"
     timeout 2000 python -m pytest "$f" -q --no-header 2>&1 | tail -2
