@@ -118,6 +118,10 @@ KNOBS: dict[str, Knob] = {
         "int", "2",
         "retries after the first failure on retry_call sites (0 = "
         "fail fast)"),
+    "PARMMG_SERVE_AUTOSCALE": Knob(
+        "flag", "1",
+        "SLO-driven autoscale controller on the serving loop (bucket "
+        "resizing + admission deferral); 0 = off"),
     "PARMMG_SERVE_CHUNK": Knob(
         "int", "1", "serve pool: tenants per packed cohort dispatch"),
     "PARMMG_SERVE_MAX_CAPP": Knob(
@@ -131,16 +135,38 @@ KNOBS: dict[str, Knob] = {
         "int", "0",
         "serve driver: max requests admitted concurrently (0 = "
         "unbounded)"),
+    "PARMMG_SERVE_MAX_QUEUE": Knob(
+        "int", "0",
+        "admission backpressure: try_submit / daemon submits are "
+        "deferred (HTTP 429) at this queue depth (0 = unbounded)"),
     "PARMMG_SERVE_MAX_RETRIES": Knob(
         "int", "2",
         "slot faults before a serve tenant is quarantined (retired "
         "FAILED, slot scrubbed)"),
+    "PARMMG_SERVE_MAX_SLOTS": Knob(
+        "int", "16",
+        "autoscale growth ceiling on any bucket's slot count"),
+    "PARMMG_SERVE_PORT": Knob(
+        "int", "8077",
+        "serve daemon: HTTP bind port (scripts/serve_daemon.py; 0 = "
+        "ephemeral)"),
     "PARMMG_SERVE_SLO_QMIN": Knob(
         "float", "0",
         "per-tenant qmin SLO floor; retirement records an slo_ok / "
         "slo_violation verdict (0 = off)"),
     "PARMMG_SERVE_SLOTS": Knob(
         "int", "4", "serve pool: slots per capacity bucket"),
+    "PARMMG_SERVE_STREAM": Knob(
+        "flag", "1",
+        "streaming admission: re-rent slots freed MID-STEP to queued "
+        "tenants; 0 = admit between steps only"),
+    "PARMMG_SERVE_STREAM_RATE": Knob(
+        "float", "2",
+        "serve_bench.py --stream open-loop arrival rate (tenants/sec)"),
+    "PARMMG_SERVE_TARGET_P99_S": Knob(
+        "float", "0",
+        "autoscale latency SLO: defer admissions while observed p99 "
+        "exceeds this with work queued (0 = off)"),
     "PARMMG_SERVE_TIMEOUT_S": Knob(
         "float", "0",
         "serve driver: per-request wall-clock timeout; the slot is "

@@ -55,6 +55,7 @@ SITES = {
     "halo.exchange": "xla",
     "analysis.ks_overflow": "flag",
     "serve.slot_step": "xla",
+    "serve.daemon_rpc": "os",
     "io.checkpoint": "os",
 }
 

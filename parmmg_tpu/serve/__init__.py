@@ -16,11 +16,24 @@ fresh XLA compiles.
 - :mod:`driver` — request lifecycle: a submit/poll/fetch API over a
   work queue (medit/VTK in, merge-free distributed checkpoints out),
   per-request AdaptStats + qmin/qmean quality SLO, admission /
-  rejection / timeout / max-in-flight knobs (``PARMMG_SERVE_*``).
+  rejection / timeout / max-in-flight knobs (``PARMMG_SERVE_*``);
+- :mod:`admission` — staging + queue pump + backpressure (429-style
+  deferral) + STREAMING mid-step slot re-rent
+  (``PARMMG_SERVE_STREAM``);
+- :mod:`autoscale` — the SLO-driven controller: bucket-ladder resizing
+  and admission deferral as a pure function of the obs metrics
+  snapshot (``PARMMG_SERVE_AUTOSCALE``);
+- :mod:`daemon` / :mod:`client` — the persistent pool SERVICE: a
+  daemon process owning the warm compiled programs for its lifetime
+  behind a stdlib HTTP/JSON RPC layer, and the jax-free client.
 
-Front-ends: ``scripts/serve_run.py`` (file-based CLI) and
+Front-ends: ``scripts/serve_daemon.py`` (the service),
+``scripts/serve_run.py`` (file-based CLI) and
 ``scripts/serve_bench.py`` (the SERVE_r* artifact: meshes/sec,
-latency percentiles, occupancy, ledger diff vs the batch path).
+latency percentiles, occupancy/queue trajectories, ledger diff vs the
+batch path; ``--stream`` = open-loop arrivals through the daemon).
 """
 from .pool import SlotPool                         # noqa: F401
 from .driver import ServeDriver, ServeRequest      # noqa: F401
+from .daemon import PoolDaemon                     # noqa: F401
+from .client import ServeClient                    # noqa: F401

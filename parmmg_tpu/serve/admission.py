@@ -1,0 +1,273 @@
+"""Admission control: staging, queue pump, backpressure, STREAMING
+slot re-rent for the serving driver (ROADMAP item 3b).
+
+PR 6's driver admitted queued tenants only BETWEEN pool steps: a slot
+freed by a tenant retiring mid-cohort sat dead until the whole step
+drained.  This module factors the admission path out of ``ServeDriver``
+and adds the two service-grade behaviours the daemon needs:
+
+- **streaming admission** (``PARMMG_SERVE_STREAM``, default on): the
+  pool's step loop reports each cohort's retirements AS THEY COMMIT
+  (``SlotPool.step(on_retire=...)``) and :meth:`AdmissionController.
+  mid_step` retires them and re-rents the freed slots to queued tenants
+  while the step is still in flight — the quiet-group fixed point
+  already proved which cohort slots retired, so the re-rented slot
+  rides the step's remaining re-scan at its own cycle 0.  Exactness:
+  a tenant's block sequence is a function of its own cycle index alone
+  (``groups.block_schedule``) and ``lax.map`` rows are independent, so
+  admission TIMING never changes a tenant's bytes — bit-for-bit
+  per-tenant parity with the between-steps path is pinned by the slow
+  test in tests/test_serve_daemon.py;
+- **backpressure** (``PARMMG_SERVE_MAX_QUEUE`` + the autoscale
+  controller's defer latch): :meth:`backpressure` gives
+  ``ServeDriver.try_submit`` a 429-style deferral reason instead of
+  letting the queue grow without bound; the daemon maps it to
+  HTTP 429 so clients retry instead of piling on.
+
+Staging (file -> Mesh, raw arrays -> Mesh) lives here too: the daemon's
+RPC edge and the queue pump share ONE staging rule, which is what makes
+daemon-served results bit-identical to standalone runs and to the
+in-process pool (gated by ledger_check.serving_gate / serve_check.py).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .pool import _env_int
+
+QUEUED = "queued"
+RUNNING = "running"
+DONE = "done"
+REJECTED = "rejected"
+FAILED = "failed"
+TIMEOUT = "timeout"
+
+TERMINAL = (DONE, REJECTED, FAILED, TIMEOUT)
+
+
+# ---------------------------------------------------------------------------
+# staging: one rule for files, raw arrays, and the daemon RPC edge
+# ---------------------------------------------------------------------------
+def _pad_met(mesh, vals):
+    """Metric values (scalar or tensor, any length <= capP) -> the
+    staged full-capP metric with unit pads, in the mesh dtype.  THE
+    one padding rule both staging paths share — bit parity between
+    daemon-staged and standalone runs rides on it."""
+    import jax.numpy as jnp
+    vals = np.asarray(vals)
+    full = np.ones((mesh.capP,) + vals.shape[1:], np.float64)
+    full[: len(vals)] = vals
+    if full.ndim == 2 and full.shape[1] == 1:
+        full = full[:, 0]
+    return jnp.asarray(full, mesh.vert.dtype)
+
+
+def stage_file(path: str, sol: str | None):
+    """File -> (core Mesh, met): medit or VTK in, analysis tags on,
+    metric from the .sol (scalar/tensor) or the -optim default."""
+    from ..core.mesh import make_mesh
+    from ..io.medit import read_mesh, read_sol
+    from ..ops.analysis import analyze_mesh
+    from ..ops.metric import metric_optim
+
+    vtu_met = None
+    if str(path).endswith(".vtu"):
+        from ..io.vtk import read_vtu_medit
+        mm, vtu_met, _fields = read_vtu_medit(path)
+    else:
+        mm = read_mesh(path)
+    mesh = make_mesh(mm.vert, mm.tetra, vref=mm.vref, tref=mm.tref)
+    mesh = analyze_mesh(mesh).mesh
+    vals = None
+    if sol:
+        vals, _types = read_sol(sol)
+    elif vtu_met is not None:
+        vals = np.asarray(vtu_met)
+    if vals is not None:
+        met = _pad_met(mesh, vals)
+    else:
+        met = metric_optim(mesh)
+    return mesh, met
+
+
+def stage_arrays(vert, tet, vref=None, tref=None, met=None):
+    """Raw arrays -> staged (core Mesh, met): the daemon RPC staging
+    rule, shared with the gates' standalone references so daemon-served
+    parity holds by construction.  Caps use the serve-bench 4x headroom
+    (``make_mesh(capP=4*nvert, capT=4*ntet)``), analysis tags are
+    computed, and the metric (scalar or tensor, any length <= capP) is
+    padded to capP with unit entries; an absent metric falls back to
+    ``metric_optim`` like the file path."""
+    from ..core.mesh import make_mesh
+    from ..ops.analysis import analyze_mesh
+    from ..ops.metric import metric_optim
+
+    vert = np.asarray(vert, np.float64)
+    tet = np.asarray(tet, np.int32)
+    mesh = make_mesh(vert, tet, vref=vref, tref=tref,
+                     capP=4 * len(vert), capT=4 * len(tet))
+    mesh = analyze_mesh(mesh).mesh
+    if met is None:
+        return mesh, metric_optim(mesh)
+    return mesh, _pad_met(mesh, met)
+
+
+def mesh_size(mesh) -> tuple[int, int]:
+    """(tet-referenced vertex count, live tet count) — the admission
+    sizing rule (``split_to_shards`` sizes capP from TET-REFERENCED
+    vertices, not vmask).  Accepts a staged core Mesh or a plain dict
+    of arrays (the stub pools of the host-only tier-1 tests)."""
+    if isinstance(mesh, dict):
+        tet = np.asarray(mesh["tet"])
+        return len(np.unique(tet)), len(tet)
+    tm = np.asarray(mesh.tmask)
+    nt = int(tm.sum())
+    nv = len(np.unique(np.asarray(mesh.tet)[tm]))
+    return nv, nt
+
+
+def met_width(met) -> int:
+    """Metric trailing width (0 = scalar) — the bucket-key component."""
+    if met is None:
+        return 0
+    a = np.asarray(met)
+    return 0 if a.ndim == 1 else int(a.shape[-1])
+
+
+# ---------------------------------------------------------------------------
+# the controller
+# ---------------------------------------------------------------------------
+class AdmissionController:
+    """Queue pump + backpressure + the streaming mid-step hook.
+
+    Owns no queue of its own — the queue and request table stay on the
+    driver (report/bench compatibility); this object owns the POLICY:
+    streaming on/off (``PARMMG_SERVE_STREAM``), the submit-time queue
+    bound (``PARMMG_SERVE_MAX_QUEUE``), and the autoscale controller's
+    defer latch (``deferring``, set by
+    ``autoscale.AutoscaleController.tick``).  Everything here is pure
+    host bookkeeping (tier-1 tested with stub pools, no jax)."""
+
+    def __init__(self, driver, max_queue: int | None = None,
+                 stream: bool | None = None):
+        self.driver = driver
+        self.max_queue = max_queue if max_queue is not None \
+            else _env_int("PARMMG_SERVE_MAX_QUEUE", 0)
+        if stream is None:
+            import os
+            stream = os.environ.get("PARMMG_SERVE_STREAM", "1") != "0"
+        self.stream = bool(stream)
+        self.deferring = False          # autoscale backpressure latch
+        self.stream_admissions = 0
+        self.deferred = 0
+
+    # ---- backpressure (429-style deferral) -------------------------------
+    def backpressure(self) -> str | None:
+        """Deferral reason for a NEW submit, or None to accept.  Never
+        affects already-queued requests — only the admission edge."""
+        if self.deferring:
+            return "autoscale backpressure (deferring admissions)"
+        if self.max_queue and len(self.driver.queue) >= self.max_queue:
+            return (f"queue full ({len(self.driver.queue)} >= "
+                    f"PARMMG_SERVE_MAX_QUEUE {self.max_queue})")
+        return None
+
+    # ---- the queue pump ---------------------------------------------------
+    def pump(self) -> list[str]:
+        """Admit queued requests into free slots (between steps, or —
+        via :meth:`mid_step` — while a step is in flight).  Staging
+        failures and oversize requests retire per-request (fault
+        isolation); "full" requests stay queued and publish the
+        per-bucket blocked-admission gauge the autoscale controller
+        grows on.  Returns the newly admitted tenant ids."""
+        import time
+
+        from ..obs import trace as otrace
+        from ..obs.metrics import REGISTRY
+        d = self.driver
+        pool = d.pool
+        admitted: list[str] = []
+        inflight = len(pool.active_tenants())
+        remaining: list[str] = []
+        blocked: dict[str, int] = {}
+        for tid in d.queue:
+            r = d.requests[tid]
+            if d.max_inflight and inflight >= d.max_inflight:
+                remaining.append(tid)
+                continue
+            try:
+                if r.mesh is None and r.path is not None:
+                    r.mesh, r.met = stage_file(r.path, r.sol)
+                nv, nt = mesh_size(r.mesh)
+                mw = met_width(r.met)
+            except Exception as e:
+                # per-request fault isolation: a corrupt input must not
+                # take down the loop or the other tenants
+                r.state = FAILED
+                r.reason = f"staging failed: {e}"
+                r.t_done = time.perf_counter()
+                continue
+            got = pool.admit(tid, nv, nt, met_width=mw)
+            if got[0] == "oversize":
+                r.state = REJECTED
+                r.reason = (f"needs caps {got[1][0]}x{got[1][1]} > pool "
+                            f"max {pool.max_capP}x{pool.max_capT}")
+                r.t_done = time.perf_counter()
+                continue
+            if got[0] == "full":
+                remaining.append(tid)       # waits for a recycled slot
+                label = pool.bucket_label(got[1])
+                blocked[label] = blocked.get(label, 0) + 1
+                continue
+            try:
+                pool.load(tid, r.mesh, r.met)
+            except Exception as e:
+                pool.release(tid)           # fault isolation (as above)
+                r.state = FAILED
+                r.reason = f"load failed: {e}"
+                r.t_done = time.perf_counter()
+                continue
+            r.state = RUNNING
+            r.t_admit = time.perf_counter()
+            inflight += 1
+            admitted.append(tid)
+            # stderr: stdout belongs to the front-ends' JSON report
+            otrace.log(1, f"serve: admitted {tid} -> bucket "
+                          f"{got[1][0]}x{got[1][1]} slot {got[2]}",
+                       verbose=d.verbose, err=True)
+        d.queue = remaining
+        REGISTRY.gauge("serve.queue_depth").set(len(d.queue))
+        # full-bucket admission pressure: the autoscale grow signal,
+        # cleared for buckets that stopped blocking this pump
+        for label in pool.labels():
+            # lint: ok(R6) — label ranges over the finite capacity
+            # ladder (same cardinality bound as serve.occupancy.*)
+            REGISTRY.gauge(f"serve.admit_blocked.{label}").set(
+                blocked.get(label, 0))
+        return admitted
+
+    # ---- streaming admission (the SlotPool.step on_retire hook) ----------
+    def mid_step(self, retired: list[str]) -> None:
+        """Retire a cohort's finished tenants NOW and re-rent their
+        freed slots from the queue, while the pool step is still in
+        flight.  The pool's re-scan picks the re-rented slots up at
+        their own cycle 0 within the same step."""
+        d = self.driver
+        for tid in retired:
+            if d.requests[tid].state == RUNNING:
+                d._retire(tid)
+        if not d.queue:
+            return
+        got = self.pump()
+        if got:
+            from ..obs import trace as otrace
+            from ..obs.metrics import REGISTRY
+            self.stream_admissions += len(got)
+            REGISTRY.counter("serve.stream_admissions").inc(len(got))
+            otrace.event("serve.stream_admit", tenants=len(got))
+
+    def summary(self) -> dict:
+        return {"stream": self.stream, "max_queue": self.max_queue,
+                "deferring": self.deferring,
+                "stream_admissions": self.stream_admissions,
+                "deferred": self.deferred}

@@ -1,43 +1,48 @@
 """Request lifecycle + queue driver for the serving pool.
 
-A minimal submit/poll/fetch front-end over :class:`serve.pool.SlotPool`
-— the "simple queue/driver front-end" of ROADMAP open item 3:
+A submit/poll/fetch front-end over :class:`serve.pool.SlotPool` — the
+queue/driver layer of ROADMAP open item 3, now composed from three
+parts:
 
-- **submit** a tenant mesh (in-memory arrays, or a medit ``.mesh[b]`` /
-  VTK ``.vtu`` file streamed through io.medit / io.vtk, with an
-  optional ``.sol`` metric) -> request id;
-- the **run loop** admits queued requests into the smallest fitting
-  bucket (FIFO, bounded by PARMMG_SERVE_MAX_INFLIGHT), steps the pool,
-  and retires converged tenants: per-request ``AdaptStats``
-  (tenant-tagged — ops.adapt.AdaptStats refuses cross-tenant merges)
-  and the qmin/qmean quality SLO are computed on retirement, the slot
-  is recycled for the next queued request;
-- **poll** returns the request state machine position
-  (queued / running / done / rejected / failed / timeout);
-- **fetch** returns the merged (Mesh, met); ``write_distributed``
-  emits the merge-free per-tenant checkpoint straight from the slot
-  state (io.distributed.stacked_to_distributed_files with a slot
-  subset — the -distributed-output contract, no centralization).
+- this driver: the request state machine (queued / running / done /
+  rejected / failed / timeout), retirement (per-request tenant-tagged
+  ``AdaptStats`` + qmin/qmean quality SLO, slot recycling, merge-free
+  ``write_distributed`` checkpoints) and the serving loop
+  (:meth:`ServeDriver.service_once` — one admit+step+retire+autoscale
+  iteration, shared by the batch :meth:`run` loop, the streaming bench
+  and the pool daemon's loop thread);
+- :mod:`serve.admission` — staging + queue pump + backpressure +
+  the STREAMING mid-step slot re-rent (``PARMMG_SERVE_STREAM``);
+- :mod:`serve.autoscale` — the SLO-driven bucket-ladder resizing and
+  admission-deferral controller (``PARMMG_SERVE_AUTOSCALE``).
+
+``submit`` enqueues unconditionally (library callers own their queue);
+``try_submit`` is the backpressure-aware edge the daemon maps to
+HTTP 429.  ``quarantine`` is the RPC-edge isolation hook (the
+``serve.daemon_rpc`` faultpoint): a request killed mid-flight retires
+FAILED with its slot scrubbed + recycled while cohort-mates keep their
+bit-identical results.
 
 Knobs (env, constructor args win): PARMMG_SERVE_MAX_INFLIGHT (0 =
 unbounded), PARMMG_SERVE_TIMEOUT_S (wall-clock per request, 0 = off),
-plus the pool's PARMMG_SERVE_SLOTS / _CHUNK / _MAX_CAPP / _MAX_CAPT.
+PARMMG_SERVE_MAX_QUEUE / _STREAM / _AUTOSCALE and the pool's
+PARMMG_SERVE_SLOTS / _CHUNK / _MAX_CAPP / _MAX_CAPT.
 """
 from __future__ import annotations
 
 import dataclasses
 import time
+from collections import deque
 
 import numpy as np
 
+from .admission import (DONE, FAILED, QUEUED, REJECTED, RUNNING,  # noqa: F401
+                        TERMINAL, TIMEOUT, AdmissionController,
+                        stage_file)
 from .pool import SlotPool, _env_int
 
-QUEUED = "queued"
-RUNNING = "running"
-DONE = "done"
-REJECTED = "rejected"
-FAILED = "failed"
-TIMEOUT = "timeout"
+# legacy import surface: _stage_file lived here before serve/admission
+_stage_file = stage_file
 
 
 @dataclasses.dataclass
@@ -63,40 +68,6 @@ class ServeRequest:
         return max(0.0, self.t_done - self.t_submit)
 
 
-def _stage_file(path: str, sol: str | None):
-    """File -> (core Mesh, met): medit or VTK in, analysis tags on,
-    metric from the .sol (scalar/tensor) or the -optim default."""
-    import jax.numpy as jnp
-    from ..core.mesh import make_mesh
-    from ..io.medit import read_mesh, read_sol
-    from ..ops.analysis import analyze_mesh
-    from ..ops.metric import metric_optim
-
-    vtu_met = None
-    if str(path).endswith(".vtu"):
-        from ..io.vtk import read_vtu_medit
-        mm, vtu_met, _fields = read_vtu_medit(path)
-    else:
-        mm = read_mesh(path)
-    mesh = make_mesh(mm.vert, mm.tetra, vref=mm.vref, tref=mm.tref)
-    mesh = analyze_mesh(mesh).mesh
-    vals = None
-    if sol:
-        vals, _types = read_sol(sol)
-    elif vtu_met is not None:
-        vals = np.asarray(vtu_met)
-    if vals is not None:
-        vals = np.asarray(vals)
-        met = np.ones((mesh.capP,) + vals.shape[1:], np.float64)
-        met[: len(vals)] = vals
-        if met.ndim == 2 and met.shape[1] == 1:
-            met = met[:, 0]
-        met = jnp.asarray(met, mesh.vert.dtype)
-    else:
-        met = metric_optim(mesh)
-    return mesh, met
-
-
 class ServeDriver:
     """FIFO queue + admission + retirement around a SlotPool."""
 
@@ -104,7 +75,11 @@ class ServeDriver:
                  out_dir: str | None = None,
                  max_inflight: int | None = None,
                  timeout_s: float | None = None,
-                 verbose: int = 0, **pool_kwargs):
+                 verbose: int = 0,
+                 stream: bool | None = None,
+                 max_queue: int | None = None,
+                 autoscale=None, retain_done: int | None = None,
+                 **pool_kwargs):
         self.pool = pool if pool is not None else SlotPool(**pool_kwargs)
         self.out_dir = out_dir
         self.max_inflight = max_inflight if max_inflight is not None \
@@ -118,6 +93,23 @@ class ServeDriver:
         self.requests: dict[str, ServeRequest] = {}
         self.queue: list[str] = []
         self._seq = 0
+        self.admission = AdmissionController(self, max_queue=max_queue,
+                                             stream=stream)
+        # autoscale: None = knob default (PARMMG_SERVE_AUTOSCALE, on),
+        # False = off, or a ready AutoscaleController instance
+        if autoscale is None:
+            from .autoscale import AutoscaleController, autoscale_enabled
+            autoscale = AutoscaleController() if autoscale_enabled() \
+                else False
+        self.autoscale = autoscale or None
+        # bounded occupancy trajectory (a daemon serves indefinitely)
+        self._occupancy_traj: deque = deque(maxlen=4096)
+        # terminal-request retention: a daemon retains at most this
+        # many finished requests (each holds its merged mesh + metric
+        # until fetched/evicted) — oldest-terminal eviction keeps the
+        # request table, and every O(requests) scan, bounded
+        self.retain_done = retain_done if retain_done is not None \
+            else 4096
 
     # ---- API --------------------------------------------------------------
     def submit(self, mesh=None, met=None, path=None, sol=None,
@@ -133,6 +125,25 @@ class ServeDriver:
         self.requests[tenant] = req
         self.queue.append(tenant)
         return tenant
+
+    def try_submit(self, mesh=None, met=None, path=None, sol=None,
+                   tenant: str | None = None):
+        """Backpressure-aware submit: returns ``(tid, None)`` when
+        accepted, ``(None, reason)`` when deferred (the daemon's
+        HTTP 429; the streaming bench retries the arrival)."""
+        from ..obs import trace as otrace
+        from ..obs.metrics import REGISTRY
+        reason = self.admission.backpressure()
+        if reason:
+            self.admission.deferred += 1
+            REGISTRY.counter("serve.deferred").inc()
+            otrace.event("serve.deferred",
+                         **({"tenant": tenant} if tenant else {}))
+            otrace.log(2, f"serve: deferred submit ({reason})",
+                       verbose=self.verbose, err=True)
+            return None, reason
+        return self.submit(mesh=mesh, met=met, path=path, sol=sol,
+                           tenant=tenant), None
 
     def poll(self, tid: str) -> dict:
         r = self.requests[tid]
@@ -159,69 +170,60 @@ class ServeDriver:
         return stacked_to_distributed_files(
             path, b.stacked, None, None, b.nslots, shards=[i])
 
-    # ---- the serving loop --------------------------------------------------
-    def _admit_from_queue(self) -> None:
-        inflight = len(self.pool.active_tenants())
-        remaining = []
-        for tid in self.queue:
-            r = self.requests[tid]
-            if self.max_inflight and inflight >= self.max_inflight:
-                remaining.append(tid)
-                continue
-            try:
-                if r.mesh is None and r.path is not None:
-                    r.mesh, r.met = _stage_file(r.path, r.sol)
-                # needP counts TET-REFERENCED vertices, exactly what
-                # split_to_shards sizes capP from — an orphan vertex
-                # must not inflate the admission bucket past the rung
-                # the split will actually produce
-                tm = np.asarray(r.mesh.tmask)
-                nt = int(tm.sum())
-                nv = len(np.unique(np.asarray(r.mesh.tet)[tm]))
-                mw = 0 if np.asarray(r.met).ndim == 1 \
-                    else int(np.asarray(r.met).shape[-1])
-            except Exception as e:
-                # per-request fault isolation: a corrupt input must not
-                # take down the loop or the other tenants
-                r.state = FAILED
-                r.reason = f"staging failed: {e}"
-                r.t_done = time.perf_counter()
-                continue
-            got = self.pool.admit(tid, nv, nt, met_width=mw)
-            if got[0] == "oversize":
-                r.state = REJECTED
-                r.reason = (f"needs caps {got[1][0]}x{got[1][1]} > pool "
-                            f"max {self.pool.max_capP}x"
-                            f"{self.pool.max_capT}")
-                r.t_done = time.perf_counter()
-                continue
-            if got[0] == "full":
-                remaining.append(tid)       # waits for a recycled slot
-                continue
-            try:
-                self.pool.load(tid, r.mesh, r.met)
-            except Exception as e:
-                self.pool.release(tid)      # fault isolation (as above)
-                r.state = FAILED
-                r.reason = f"load failed: {e}"
-                r.t_done = time.perf_counter()
-                continue
-            r.state = RUNNING
-            r.t_admit = time.perf_counter()
-            inflight += 1
-            # stderr: stdout belongs to the front-ends' JSON report
-            from ..obs.trace import log as _olog
-            _olog(1, f"serve: admitted {tid} -> bucket "
-                     f"{got[1][0]}x{got[1][1]} slot {got[2]}",
-                  verbose=self.verbose, err=True)
-        self.queue = remaining
+    def stage_payload(self, arrays: dict):
+        """npz-style array payload -> staged (mesh, met) — the daemon's
+        RPC staging edge (one rule with admission.stage_arrays so
+        daemon-served results are bit-identical to standalone runs).
+        Overridable by the host-only stub drivers in tier-1 tests."""
+        from .admission import stage_arrays
+        return stage_arrays(
+            arrays["vert"], arrays["tet"],
+            vref=arrays.get("vref"), tref=arrays.get("tref"),
+            met=arrays.get("met"))
+
+    def quarantine(self, tid: str, reason: str) -> bool:
+        """RPC-edge quarantine (the ``serve.daemon_rpc`` faultpoint's
+        isolation contract): a request killed mid-flight retires FAILED
+        — a RUNNING tenant's slot is scrubbed + recycled through the
+        normal retirement path, a QUEUED one is dropped from the queue
+        — and cohort-mates are untouched (slot isolation).  Returns
+        False for unknown or already-terminal requests (no-op)."""
+        from ..obs import trace as otrace
         from ..obs.metrics import REGISTRY
-        REGISTRY.gauge("serve.queue_depth").set(len(self.queue))
+        r = self.requests.get(tid)
+        if r is None or r.state in TERMINAL:
+            return False
+        self.pool.quarantined.append(tid)
+        REGISTRY.counter("serve.quarantined").inc()
+        otrace.event("serve.quarantine", tenant=tid, detail=reason[:300])
+        if r.state == RUNNING:
+            self.pool.slot_of(tid).failed = reason
+            self._retire(tid)
+        else:
+            self.queue = [t for t in self.queue if t != tid]
+            REGISTRY.gauge("serve.queue_depth").set(len(self.queue))
+            r.state = FAILED
+            r.reason = reason
+            r.t_done = time.perf_counter()
+        otrace.log(1, f"serve: QUARANTINED {tid} at the RPC edge "
+                      f"({reason})", verbose=self.verbose, err=True)
+        return True
+
+    # ---- retirement -------------------------------------------------------
+    def _quality(self, mesh, met) -> dict:
+        """Merged tenant state -> the quality/SLO fields (overridable
+        by the host-only stub drivers in tier-1 tests)."""
+        from ..ops.quality import quality_histogram, tet_quality
+        q = tet_quality(mesh, met)
+        _, qmin, qmean, nbad = quality_histogram(q, mesh.tmask)
+        return {"qmin": round(float(qmin), 6),
+                "qmean": round(float(qmean), 6),
+                "nbad": int(nbad),
+                "ntets": int(np.asarray(mesh.tmask).sum())}
 
     def _retire(self, tid: str) -> None:
         from ..obs.metrics import REGISTRY
         from ..obs.trace import log as _olog
-        from ..ops.quality import quality_histogram, tet_quality
         r = self.requests[tid]
         slot = self.pool.slot_of(tid)
         r.stats = slot.stats
@@ -236,12 +238,7 @@ class ServeDriver:
                                self.write_distributed(tid, out)]
             mesh, met = self.pool.merge(tid)
             r.mesh, r.met = mesh, met
-            q = tet_quality(mesh, met)
-            _, qmin, qmean, nbad = quality_histogram(q, mesh.tmask)
-            r.quality = {"qmin": round(float(qmin), 6),
-                         "qmean": round(float(qmean), 6),
-                         "nbad": int(nbad),
-                         "ntets": int(np.asarray(mesh.tmask).sum())}
+            r.quality = self._quality(mesh, met)
             r.state = DONE
             # per-tenant SLO verdict (machine-readable, tenant-tagged):
             # quality floor from PARMMG_SERVE_SLO_QMIN (0 = quality SLO
@@ -290,29 +287,83 @@ class ServeDriver:
                 r.t_done = now
                 self.queue = [t for t in self.queue if t != tid]
 
+    # ---- the serving loop --------------------------------------------------
+    def service_once(self) -> str:
+        """One serving-loop iteration: expire timeouts, pump the
+        admission queue, run the autoscale controller, advance the pool
+        one step (with streaming mid-step re-rent when enabled) and
+        retire finished tenants.  Returns the loop state:
+
+        - ``"active"`` — tenants advanced (call again immediately);
+        - ``"idle"``   — nothing queued, nothing running;
+        - ``"stalled"``— queued work the pool could not admit with
+          every slot free (capacity deadlock; :meth:`run` rejects it,
+          a daemon keeps waiting — timeouts still apply)."""
+        self._expire_timeouts()
+        admitted = self.admission.pump()
+        if self.autoscale is not None:
+            d = self.autoscale.tick(self.pool, self.admission)
+            if d.grow and self.queue:
+                # a grown bucket can admit immediately — don't make the
+                # blocked tenant wait one extra loop iteration
+                admitted += self.admission.pump()
+        if not self.pool.active_tenants():
+            if self.queue and not admitted:
+                return "stalled"
+            if not self.queue and not admitted:
+                return "idle"
+            return "active"
+        self._occupancy_traj.append(self.pool.occupancy())
+        on_retire = self.admission.mid_step if self.admission.stream \
+            else None
+        for tid in self.pool.step(verbose=self.verbose,
+                                  on_retire=on_retire):
+            # streaming mode already retired mid-step; retire the rest
+            if self.requests[tid].state == RUNNING:
+                self._retire(tid)
+        self._evict_terminal()
+        return "active"
+
+    def _evict_terminal(self) -> None:
+        """Bound the request table for indefinite serving: beyond
+        ``retain_done`` requests, evict the OLDEST terminal ones (each
+        DONE request pins its merged mesh + metric until fetched).  An
+        evicted id polls/fetches as unknown, and :meth:`report` covers
+        retained requests only — the bounded-history contract of a
+        persistent service (batch ``run()`` callers stay whole below
+        the default 4096 bound)."""
+        excess = len(self.requests) - self.retain_done
+        if excess <= 0:
+            return
+        terminal = sorted(
+            (r.t_done, tid) for tid, r in self.requests.items()
+            if r.state in TERMINAL)
+        for _t, tid in terminal[:excess]:
+            del self.requests[tid]
+
+    def _reject_stalled(self) -> None:
+        """Terminal handling of a capacity deadlock (e.g. max_inflight
+        with 0 slots): reject everything still queued rather than
+        spin."""
+        for tid in self.queue:
+            r = self.requests[tid]
+            r.state = REJECTED
+            r.reason = "pool cannot admit (no slot ever)"
+            r.t_done = time.perf_counter()
+        self.queue = []
+
     def run(self, max_steps: int = 10000) -> dict:
         """Drive the loop until every request reaches a terminal state.
         Returns the serving report (per-tenant + pool aggregates)."""
-        occupancy_traj = []
+        self._occupancy_traj.clear()
         for _ in range(max_steps):
-            self._expire_timeouts()
-            self._admit_from_queue()
-            if not self.pool.active_tenants():
-                if self.queue:
-                    # queued work but nothing admitted: deadlocked on
-                    # capacity (e.g. max_inflight 0 slots) — bail out
-                    # rather than spin
-                    for tid in self.queue:
-                        r = self.requests[tid]
-                        r.state = REJECTED
-                        r.reason = "pool cannot admit (no slot ever)"
-                        r.t_done = time.perf_counter()
-                    self.queue = []
+            st = self.service_once()
+            if st == "idle":
                 break
-            occupancy_traj.append(self.pool.occupancy())
-            for tid in self.pool.step(verbose=self.verbose):
-                self._retire(tid)
-        return self.report(occupancy_traj)
+            if st == "stalled":
+                self._reject_stalled()
+                break
+        return self.report(list(self._occupancy_traj))
 
     # ---- reporting ----------------------------------------------------------
     def report(self, occupancy_traj=None) -> dict:
@@ -356,7 +407,11 @@ class ServeDriver:
                           if t["state"] in (FAILED, TIMEOUT)),
             "latency_p50_s": pct(0.50),
             "latency_p90_s": pct(0.90),
+            "latency_p99_s": pct(0.99),
             "latency_max_s": lat[-1] if lat else 0.0,
+            "admission": self.admission.summary(),
+            "autoscale": (self.autoscale.summary()
+                          if self.autoscale is not None else None),
             "pool": {
                 "steps": self.pool.steps,
                 "dispatches": self.pool.dispatches,

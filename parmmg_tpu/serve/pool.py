@@ -211,11 +211,62 @@ class SlotPool:
             self._zero_row(b, i)
         b.slots[i] = Slot()
 
+    @staticmethod
+    def bucket_label(key: tuple) -> str:
+        """Report/metric spelling of a bucket key: ``capPxcapT`` plus a
+        metric-width suffix keeping scalar- and tensor-metric buckets
+        of equal caps from colliding on one report/gauge key."""
+        return f"{key[0]}x{key[1]}" + (f"m{key[2]}" if key[2] else "")
+
+    def labels(self) -> dict:
+        """{report label: bucket key} — the autoscale actuator's map
+        from metric-series bucket names back to pool buckets."""
+        return {self.bucket_label(k): k for k in self.buckets}
+
     def occupancy(self) -> dict:
-        # the metric-width component keeps scalar- and tensor-metric
-        # buckets of equal caps from colliding on one report key
-        return {f"{k[0]}x{k[1]}" + (f"m{k[2]}" if k[2] else ""):
-                b.occupancy() for k, b in sorted(self.buckets.items())}
+        return {self.bucket_label(k): b.occupancy()
+                for k, b in sorted(self.buckets.items())}
+
+    def resize_bucket(self, key: tuple, nslots: int) -> int:
+        """Autoscale actuator: grow/shrink one bucket's slot count.
+
+        Growth appends born-quiet dead rows (all-zero, the _pad_groups
+        convention) — compiled shapes are untouched because dispatches
+        gather ``[chunk, ...]`` row slices, never the whole
+        ``[nslots, ...]`` array, so resizing adds zero compile
+        families.  Shrink drops TRAILING FREE slots only (never evicts
+        or renumbers a tenant: ``_where`` holds live slot indices), so
+        the result may stay larger than requested.  Returns the actual
+        new slot count."""
+        b = self.buckets[key]
+        want = max(1, int(nslots))
+        if want > b.nslots:
+            add = want - b.nslots
+            b.slots.extend(Slot() for _ in range(add))
+            if b.stacked is not None:
+                import jax
+                b.stacked = jax.tree.map(
+                    lambda a: np.concatenate(
+                        [a, np.zeros((add,) + a.shape[1:], a.dtype)]),
+                    b.stacked)
+                b.met = np.concatenate(
+                    [b.met, np.zeros((add,) + b.met.shape[1:],
+                                     b.met.dtype)])
+            b.nslots = want
+        elif want < b.nslots:
+            keep = b.nslots
+            while keep > want and b.slots[keep - 1].tenant is None:
+                keep -= 1
+            if keep < b.nslots:
+                b.slots = b.slots[:keep]
+                if b.stacked is not None:
+                    import jax
+                    b.stacked = jax.tree.map(
+                        lambda a: np.ascontiguousarray(a[:keep]),
+                        b.stacked)
+                    b.met = np.ascontiguousarray(b.met[:keep])
+                b.nslots = keep
+        return b.nslots
 
     def active_tenants(self) -> list[str]:
         return [t for t, (k, i) in self._where.items()
@@ -416,17 +467,7 @@ class SlotPool:
             # a full promotion bucket grows by one slot rather than
             # deadlocking the overflowed tenant (it already paid the
             # regrow; queueing it cannot make progress)
-            nb.nslots += 1
-            nb.slots.append(Slot())
-            if nb.stacked is not None:
-                import jax
-                nb.stacked = jax.tree.map(
-                    lambda a: np.concatenate(
-                        [a, np.zeros((1,) + a.shape[1:], a.dtype)]),
-                    nb.stacked)
-                nb.met = np.concatenate(
-                    [nb.met, np.zeros((1,) + nb.met.shape[1:],
-                                      nb.met.dtype)])
+            self.resize_bucket(nkey, nb.nslots + 1)
             j = nb.nslots - 1
         if nb.stacked is None:
             import jax
@@ -458,13 +499,30 @@ class SlotPool:
         if s.stats is not None:
             s.stats.regrows += 1
 
-    def step(self, verbose: int = 0) -> list[str]:
+    def step(self, verbose: int = 0, on_retire=None) -> list[str]:
         """Advance every active tenant by one cycle block.  Returns the
-        tenants that reached their fixed point (converged) this step.
+        tenants that reached a terminal state (converged/failed) this
+        step.
 
         Slots of one bucket at the same cycle index share (flags, pres,
         wave) and ride compacted [chunk, ...] dispatches of the SAME
-        cached compiled programs the batch grouped path uses."""
+        cached compiled programs the batch grouped path uses.
+
+        ``on_retire`` (streaming admission, serve/admission.py): when
+        given, it is called with each cohort's newly-retired tenants AS
+        THEY RETIRE, while the step is still in flight.  The callback
+        may release slots and admit+load queued tenants into them; the
+        step then RE-SCANS for tenants it has not yet dispatched this
+        step and picks the re-rented slots up at their own cycle 0 — a
+        freed slot is re-rented without waiting for the cohort (or the
+        step) to drain.  Each TENANT dispatches at most once per step
+        (a regrown tenant re-runs its block next step, either mode), so
+        existing tenants advance exactly one block either way.
+        Per-tenant parity with the between-steps path is exact: a
+        tenant's block sequence is a function of its own cycle index
+        alone (groups.block_schedule) and ``lax.map`` rows are
+        independent, so WHEN a tenant is admitted never changes WHAT it
+        computes (pinned by tests/test_serve_daemon.py)."""
         import jax.numpy as jnp
         from ..obs import trace as otrace
         from ..obs.metrics import REGISTRY
@@ -475,65 +533,82 @@ class SlotPool:
         self.steps += 1
         done: list[str] = []
         block = default_cycle_block()
-        for key, b in sorted(self.buckets.items()):
-            # same key spelling as occupancy(): the met-width suffix
-            # keeps scalar- and tensor-metric buckets of equal caps
-            # from colliding on one gauge series
-            occ, _nslots = b.occupancy()
-            # lint: ok(R6) — key is a capacity-ladder bucket (geo
-            # ladder from bucket(), capped by PARMMG_SERVE_MAX_CAP*):
-            # O(log cap) distinct series, not unbounded
-            REGISTRY.gauge(
-                f"serve.occupancy.{key[0]}x{key[1]}"
-                + (f"m{key[2]}" if key[2] else "")).set(occ)
-            act = [(i, s) for i, s in enumerate(b.slots)
-                   if s.tenant is not None and s.loaded
-                   and not s.converged and not s.failed]
-            if act:
+        stepped: set[str] = set()       # tenants dispatched this step
+        while True:
+            progressed = False
+            # sorted() snapshots the key list: a regrow or a streaming
+            # re-rent may add buckets mid-scan (picked up on re-scan)
+            for key in sorted(self.buckets):
+                b = self.buckets[key]
+                occ, nslots = b.occupancy()
+                label = self.bucket_label(key)
+                # lint: ok(R6) — label is a capacity-ladder bucket (geo
+                # ladder from bucket(), capped by PARMMG_SERVE_MAX_CAP*):
+                # O(log cap) distinct series, not unbounded
+                REGISTRY.gauge(f"serve.occupancy.{label}").set(occ)
+                # lint: ok(R6) — same capacity-ladder cardinality bound
+                REGISTRY.gauge(f"serve.slots.{label}").set(nslots)
+                act = [(i, s) for i, s in enumerate(b.slots)
+                       if s.tenant is not None and s.loaded
+                       and not s.converged and not s.failed
+                       and s.tenant not in stepped]
+                if not act:
+                    continue
                 self.active_per_step.append(len(act))
-            cohorts: dict[int, list[int]] = {}
-            for i, s in act:
-                cohorts.setdefault(s.c, []).append(i)
-            for c in sorted(cohorts):
-                ids = cohorts[c]
-                nblk = min(block, self.cycles - c)
-                flags, pres = block_schedule(c, nblk, self.cycles,
-                                             self.noswap)
-                fn = _group_block(flags, pres, self.nomove,
-                                  self.noinsert, self.hausd)
-                rows = self._dispatch_cohort(
-                    b, fn, jnp.asarray(c, jnp.int32), ids, done)
-                for i, crow in rows:
-                    s = b.slots[i]
-                    cs = crow.astype(np.int64)           # [nblk, 8]
-                    st = s.stats
-                    for ib in range(nblk):
-                        st.nsplit += int(cs[ib][0])
-                        st.ncollapse += int(cs[ib][1])
-                        st.nswap += int(cs[ib][2])
-                        st.nmoved += int(cs[ib][3])
-                        st.cycles += 1
-                    st.group_dispatches += 1
-                    st.sched_extra.setdefault("ops_per_block", []).append(
-                        int(cs[:, :4].sum()))
-                    if int(cs[:, 4].max()) != 0:
-                        # batch regrow semantics: promote the post-run
-                        # state, re-run the SAME block next step
-                        try:
-                            self._grow_tenant(s.tenant)
-                        except MemoryError as e:
-                            s.failed = str(e)
+                cohorts: dict[int, list[int]] = {}
+                for i, s in act:
+                    cohorts.setdefault(s.c, []).append(i)
+                for c in sorted(cohorts):
+                    ids = cohorts[c]
+                    n_done0 = len(done)
+                    nblk = min(block, self.cycles - c)
+                    flags, pres = block_schedule(c, nblk, self.cycles,
+                                                 self.noswap)
+                    fn = _group_block(flags, pres, self.nomove,
+                                      self.noinsert, self.hausd)
+                    stepped.update(b.slots[i].tenant for i in ids)
+                    progressed = True
+                    rows = self._dispatch_cohort(
+                        b, fn, jnp.asarray(c, jnp.int32), ids, done)
+                    for i, crow in rows:
+                        s = b.slots[i]
+                        cs = crow.astype(np.int64)           # [nblk, 8]
+                        st = s.stats
+                        for ib in range(nblk):
+                            st.nsplit += int(cs[ib][0])
+                            st.ncollapse += int(cs[ib][1])
+                            st.nswap += int(cs[ib][2])
+                            st.nmoved += int(cs[ib][3])
+                            st.cycles += 1
+                        st.group_dispatches += 1
+                        st.sched_extra.setdefault(
+                            "ops_per_block", []).append(
+                            int(cs[:, :4].sum()))
+                        if int(cs[:, 4].max()) != 0:
+                            # batch regrow semantics: promote the
+                            # post-run state, re-run the SAME block
+                            # next step
+                            try:
+                                self._grow_tenant(s.tenant)
+                            except MemoryError as e:
+                                s.failed = str(e)
+                                done.append(s.tenant)
+                            continue
+                        s.c = c + nblk
+                        if block_converged(cs, flags, self.noswap) \
+                                or s.c >= self.cycles:
+                            s.converged = True
                             done.append(s.tenant)
-                        continue
-                    s.c = c + nblk
-                    if block_converged(cs, flags, self.noswap) \
-                            or s.c >= self.cycles:
-                        s.converged = True
-                        done.append(s.tenant)
-                otrace.log(2, f"  serve step {self.steps} bucket "
-                              f"{key[0]}x{key[1]} c{c}: {len(ids)} "
-                              f"tenants, {len(rows)} dispatched",
-                           verbose=verbose, err=True)
+                    otrace.log(2, f"  serve step {self.steps} bucket "
+                                  f"{key[0]}x{key[1]} c{c}: {len(ids)} "
+                                  f"tenants, {len(rows)} dispatched",
+                               verbose=verbose, err=True)
+                    if on_retire is not None and len(done) > n_done0:
+                        # mid-step retirement hook: slots freed by this
+                        # cohort may be re-rented before the step ends
+                        on_retire(done[n_done0:])
+            if on_retire is None or not progressed:
+                break
         return done
 
     def run_to_completion(self, max_steps: int = 1000) -> list[str]:

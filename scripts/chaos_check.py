@@ -25,7 +25,12 @@ escalation-ladder step (resilience/recover.py):
 6. **serve-pool quarantine** (``serve.slot_step;key=<tenant>``): a
    persistently faulting tenant is retired FAILED/quarantined while
    its cohort-mates retire bit-identical to a fault-free pool; a
-   transient tenant fault recovers in-step with full parity.
+   transient tenant fault recovers in-step with full parity;
+7. **daemon RPC fault** (``serve.daemon_rpc:key=<tenant>``): an RPC
+   handled for a mid-flight tenant dies; the DAEMON survives, that
+   tenant alone is quarantined (retired FAILED, slot scrubbed +
+   recycled) and cohort-mates retire bit-identical to the fault-free
+   daemon and to the in-process pool.
 
 CPU backend, axon factory dropped (ledger_check.py sequence).
 """
@@ -331,6 +336,78 @@ def main() -> int:
         rep_c, outs_c = run_pool()
     check(rep_c["served"] == 3 and outs_c == outs_a,
           "transient tenant fault recovers in-step with full parity")
+
+    # ---- 7. daemon RPC fault -> mid-flight kill + quarantine -----------
+    print("--- chaos gate: serve.daemon_rpc mid-flight kill")
+    from parmmg_tpu.core.mesh import MESH_FIELDS
+    from parmmg_tpu.serve.client import ServeClient, ServeDaemonError
+    from parmmg_tpu.serve.daemon import PoolDaemon
+    from parmmg_tpu.utils.fixtures import cube_mesh
+
+    vert, tet = cube_mesh(2)
+    met_full = np.full(4 * len(vert), 0.35)   # == fresh_case() staging
+
+    def arrays_bytes(arrays):
+        return tuple(arrays[f].tobytes() for f in MESH_FIELDS) \
+            + (arrays["met"].tobytes(),)
+
+    def run_daemon_pool(kill_t1: bool):
+        d = PoolDaemon(port=0, slots_per_bucket=3, chunk=2,
+                       cycles=CYCLES, start_paused=True)
+        d.start()
+        outs = {}
+        rep = None
+        try:
+            cl = ServeClient(port=d.port)
+            for t in ("t0", "t1", "t2"):
+                cl.submit(vert=vert, tet=tet, met=met_full, tenant=t)
+            cl.step()         # admits all 3 + advances one block each
+            if kill_t1:
+                check(cl.poll("t1")["state"] == "running",
+                      "t1 is mid-flight (RUNNING) after one step")
+                with env(PARMMG_FAULT="serve.daemon_rpc:key=t1"):
+                    try:
+                        cl.poll("t1")
+                        check(False, "armed serve.daemon_rpc fault did "
+                                     "not fire")
+                    except ServeDaemonError as e:
+                        check(e.status == 500
+                              and e.body.get("quarantined") is True,
+                              "RPC fault killed the in-flight request "
+                              f"(HTTP {e.status}, tenant quarantined)")
+                check(cl.health().get("ok") is True,
+                      "daemon survives the RPC fault")
+            cl.resume()
+            for t in ("t0", "t2") + (() if kill_t1 else ("t1",)):
+                got = cl.wait(t, timeout_s=600)
+                check(got["state"] == "done",
+                      f"daemon tenant {t} served ({got['state']})")
+                outs[t] = arrays_bytes(cl.fetch(t))
+            rep = cl.report()
+        finally:
+            d.shutdown()
+        return rep, outs
+
+    rep_d0, outs_d0 = run_daemon_pool(kill_t1=False)
+    check(rep_d0["served"] == 3,
+          f"fault-free daemon serves 3 ({rep_d0['served']})")
+    check(all(outs_d0.get(t) == outs_a[t] for t in ("t0", "t1", "t2")),
+          "daemon-served tenants bit-identical to the in-process pool")
+    c0 = counters()
+    rep_d1, outs_d1 = run_daemon_pool(kill_t1=True)
+    check(rep_d1["tenants"]["t1"]["state"] == "failed"
+          and "daemon rpc fault" in rep_d1["tenants"]["t1"]["reason"],
+          "killed request retired FAILED "
+          f"({rep_d1['tenants']['t1']['reason']!r})")
+    check("t1" in rep_d1["pool"]["quarantined"],
+          "RPC-edge quarantine visible in the pool report")
+    check(delta(c0, "serve.quarantined") >= 1,
+          "serve.quarantined counter bumped")
+    check(delta(c0, "serve.rpc_faults") >= 1,
+          "serve.rpc_faults counter bumped")
+    check(outs_d1.get("t0") == outs_a["t0"]
+          and outs_d1.get("t2") == outs_a["t2"],
+          "cohort-mates of the killed request retire bit-identical")
 
     # ---- verdict -------------------------------------------------------
     if FAILS:

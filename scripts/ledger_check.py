@@ -217,14 +217,62 @@ def serving_gate() -> int:
             print(f"SERVING PARITY: tenant n{n} metric differs",
                   file=sys.stderr)
             return 1
+    # daemon mode: the SAME tenants through the pool daemon's HTTP RPC
+    # surface (in-process ephemeral-port daemon, so the compile ledger
+    # is shared) must add ZERO groups.* families vs the in-process pool
+    # above, and every fetched result must stay bit-identical to the
+    # standalone run — the daemon is a transport, never a new program
+    from parmmg_tpu.serve.client import ServeClient
+    from parmmg_tpu.serve.daemon import PoolDaemon
+    from parmmg_tpu.utils.fixtures import cube_mesh
+    print("--- serving scenario (daemon mode, 2 tenants over HTTP)")
+    daemon = PoolDaemon(port=0, slots_per_bucket=2, chunk=1,
+                        cycles=cycles)
+    daemon.start()
+    try:
+        cl = ServeClient(port=daemon.port)
+        tids = {}
+        for n, h in classes:
+            vert, tet = cube_mesh(n)
+            # full-capP metric: identical staging to tenant() above
+            tids[n] = cl.submit(vert=vert, tet=tet,
+                                met=np.full(4 * len(vert), h),
+                                tenant=f"d{n}")
+        for n, _h in classes:
+            got = cl.wait(tids[n], timeout_s=600)
+            if got["state"] != "done":
+                print(f"SERVING GATE (daemon): tenant d{n} ended "
+                      f"{got['state']}: {got.get('reason', '')}",
+                      file=sys.stderr)
+                return 1
+            arrays = cl.fetch(tids[n])
+            ref, kref = refs[n]
+            for f in MESH_FIELDS:
+                if not (arrays[f] == np.asarray(getattr(ref, f))).all():
+                    print(f"SERVING PARITY (daemon): tenant d{n} field "
+                          f"{f} differs from the standalone run",
+                          file=sys.stderr)
+                    return 1
+            if not (arrays["met"] == np.asarray(kref)).all():
+                print(f"SERVING PARITY (daemon): tenant d{n} metric "
+                      "differs", file=sys.stderr)
+                return 1
+    finally:
+        daemon.shutdown()
+    v2 = grp_variants()
+    if v2 != v1:
+        print("SERVING COMPILE-FAMILY REGRESSIONS (daemon mode added "
+              f"variants vs the in-process pool): {v1} -> {v2}",
+              file=sys.stderr)
+        return 1
     bad = ledger_violations()
     if bad:
         print("\nLEDGER BUDGET VIOLATIONS (serving):", file=sys.stderr)
         for v in bad:
             print(f"  {v}", file=sys.stderr)
         return 1
-    print(f"serving OK: zero new compile families ({v1}), "
-          "bit-for-bit parity with the batch grouped path")
+    print(f"serving OK: zero new compile families ({v2}), bit-for-bit "
+          "parity with the batch grouped path (in-process AND daemon)")
     return 0
 
 

@@ -23,7 +23,7 @@ fi
 # The compile-heavy gates below pay minutes of XLA:CPU compile — run
 # the seconds-cheap static lint first so hygiene violations fail fast.
 if [ "${1:-}" = "--ledger" ] || [ "${1:-}" = "--obs" ] \
-        || [ "${1:-}" = "--chaos" ]; then
+        || [ "${1:-}" = "--chaos" ] || [ "${1:-}" = "--serve" ]; then
     python scripts/lint_check.py || exit 1
 fi
 
@@ -61,6 +61,15 @@ fi
 # ZERO new groups.* compile families.
 if [ "${1:-}" = "--chaos" ]; then
     exec env JAX_PLATFORMS=cpu python scripts/chaos_check.py
+fi
+
+# --serve: serving-daemon gate (scripts/serve_check.py) — start the
+# pool daemon on an ephemeral port, submit 2 small tenants over
+# localhost HTTP, fetch, assert bit-for-bit parity with their
+# standalone grouped runs and ZERO new groups.* compile families after
+# the standalone warmup, then a clean shutdown (threads joined).
+if [ "${1:-}" = "--serve" ]; then
+    exec env JAX_PLATFORMS=cpu python scripts/serve_check.py
 fi
 
 fail=0
