@@ -268,62 +268,146 @@ def main() -> None:
                  "qmin": round(qmin_a, 4),
                  "qmean": round(qmean_a, 4)}
 
-    # ---- grouped-analysis extraction probe (ROADMAP decision input) -----
-    # dist_analysis_grouped extracts the [12*capT] record table twice per
-    # group per refresh (pack + tail) rather than persisting a
-    # [G, 12*capT] intermediate; extract2x_s = measured seconds of ONE
-    # extraction at the bench mesh's shape, so the redundant cost per
-    # refresh (~ G x this) is visible in every BENCH artifact and the
-    # fused single-pass variant can be justified (or dropped) from data.
-    extract2x_s = None
-    if os.environ.get("BENCH_EXTRACT2X", "1") == "1":
+    # ---- grouped-analysis extraction probe (ROADMAP 4a, closed) ---------
+    # dist_analysis_grouped now extracts the [12*capT] record table ONCE
+    # per group per refresh (the PR-12 fusion: phase 1 carries the
+    # verdict bits across the map, the tail re-derives only cheap
+    # endpoint gathers).  extract1x_s = measured seconds of ONE
+    # extraction at the bench mesh's shape — i.e. the per-group
+    # per-refresh cost the fusion REMOVED (before = 2x this per group,
+    # after = 1x).  Replaces the retired extract2x_s decision input.
+    extract1x_s = None
+    if os.environ.get("BENCH_EXTRACT2X", "1") == "1":   # knob name kept
         try:
             from parmmg_tpu.parallel.analysis_dev import \
                 extract_probe_seconds
             glo_p = jnp.arange(m.vert.shape[0], dtype=jnp.int32)
-            extract2x_s = round(extract_probe_seconds(m, glo_p), 5)
+            extract1x_s = round(extract_probe_seconds(m, glo_p), 5)
         except Exception as e:          # probe must never kill the bench
-            print(f"bench: extract2x probe failed ({e!r})",
+            print(f"bench: extract1x probe failed ({e!r})",
                   file=sys.stderr)
 
     # ---- quiet-group scheduler datapoint (opt-in: BENCH_GROUPED=1) ------
-    # a small grouped_adapt_pass with chunked dispatch, reporting the
-    # scheduler's saved-dispatch counters + active-group trajectory +
-    # pipeline segment times.  Opt-in because the group block is a fresh
-    # compile family on a cold cache; scripts/scale_big.py carries the
-    # same counters on the real grouped workload.
+    # the device-resident quiet-mask before/after (PR 12): the SAME
+    # grouped shock pass runs UNCHUNKED twice in one process — mask off
+    # (every lax.map slot computes, the pre-PR-12 steady state: at
+    # chunk 0 host compaction cannot skip anything) then mask on
+    # (lax.cond identity for quiet slots) — through the same compiled
+    # program, and the artifact records both steady-state seconds/cycle
+    # plus a byte-compare of the merged outputs (extra.parity_ok).
+    # Opt-in because the group block is a fresh compile family on a
+    # cold cache; scripts/scale_big.py carries the same counters on the
+    # real grouped workload.
     group_sched = None
+    parity_ok = None
     if os.environ.get("BENCH_GROUPED", "0") == "1":
+        from parmmg_tpu.core.mesh import MESH_FIELDS
         from parmmg_tpu.ops.adapt import AdaptStats
         from parmmg_tpu.parallel.groups import grouped_adapt_pass
         n_g = int(os.environ.get("BENCH_GROUPED_N", "6"))
-        chunk_prev = os.environ.get("PARMMG_GROUP_CHUNK")
-        os.environ.setdefault("PARMMG_GROUP_CHUNK", "1")
+        ngr = 3
+        cycles_g = int(os.environ.get("BENCH_GROUPED_CYCLES", "12"))
+        prev_env = {k: os.environ.get(k)
+                    for k in ("PARMMG_GROUP_CHUNK", "PARMMG_DEVICE_MASK")}
+        os.environ["PARMMG_GROUP_CHUNK"] = "0"
+        # x-slab groups on the shock metric, with the far field CLAMPED
+        # into the metric dead band (h <= 1.3/n: edges stay inside
+        # (LSHRT, LLONG), no far-field coarsening) — the CFD-style
+        # shock-capture scenario: refine the front into an
+        # already-adequate background mesh.  The refinement band
+        # (x=0.5) lives in the middle slab, so the outer slabs hit
+        # their fixed point within the first swap-inclusive block —
+        # the quiet-group population whose wave math the device mask
+        # elides.  (The unclamped bench metric coarsens the far field
+        # ~2-3x, a collapse trickle that keeps every group active to
+        # the last cycle; a morton split additionally puts the shock
+        # in every group — neither layout ever shows the steady state
+        # the scheduler exists for.)
+        vg, tg = cube_mesh(n_g)
+        cent_g = vg[tg].mean(axis=1)
+        part_g = np.minimum((cent_g[:, 0] * ngr).astype(np.int64),
+                            ngr - 1)
+
+        def run_grouped(mask: str, reps: int = 1):
+            # the pass is deterministic from its input: repeat runs
+            # produce identical bytes, so min-of-reps is a pure timing
+            # de-noiser (the 1-core host shows ~10% run-to-run spread)
+            os.environ["PARMMG_DEVICE_MASK"] = mask
+            best = None
+            for _ in range(max(1, reps)):
+                mg = make_mesh(vg, tg, capP=4 * len(vg),
+                               capT=4 * len(tg))
+                mg = analyze_mesh(mg).mesh
+                hg = np.minimum(
+                    analytic_iso_metric(vg, "shock", h=1.5 / n_g),
+                    1.3 / n_g)
+                kg = jnp.zeros(mg.capP, mg.vert.dtype).at[
+                    : len(hg)].set(jnp.asarray(hg, mg.vert.dtype)).at[
+                    len(hg):].set(1.0)
+                st_g = AdaptStats()
+                t0 = time.perf_counter()
+                out_g, met_g, _ = grouped_adapt_pass(mg, kg, ngr,
+                                                     cycles=cycles_g,
+                                                     part=part_g,
+                                                     stats=st_g)
+                dt = time.perf_counter() - t0
+                best = dt if best is None else min(best, dt)
+            return out_g, met_g, st_g, best
         try:
-            vg, tg = cube_mesh(n_g)
-            mg = make_mesh(vg, tg, capP=4 * len(vg), capT=4 * len(tg))
-            mg = analyze_mesh(mg).mesh
-            hg = analytic_iso_metric(vg, "shock", h=1.5 / n_g)
-            kg = jnp.zeros(mg.capP, mg.vert.dtype).at[: len(hg)].set(
-                jnp.asarray(hg, mg.vert.dtype)).at[len(hg):].set(1.0)
-            st_g = AdaptStats()
-            t0 = time.perf_counter()
-            grouped_adapt_pass(mg, kg, 3, cycles=6, stats=st_g)
+            run_grouped("0")                      # compile warm-up
+            ref_g, kref_g, st0, t_off = run_grouped("0", reps=3)
+            chk_g, kchk_g, st1, t_on = run_grouped("1", reps=3)
+            parity_ok = bool(
+                all((np.asarray(getattr(ref_g, f))
+                     == np.asarray(getattr(chk_g, f))).all()
+                    for f in MESH_FIELDS)
+                and (np.asarray(kref_g) == np.asarray(kchk_g)).all())
+            # one CHUNKED mask-on run: the double-buffered pipeline's
+            # measured segment timings feed the chunk auto-tune's
+            # overhead calibration (sched.calibrate_dispatch_overhead,
+            # ROADMAP 1b) — recorded so the artifact carries a real
+            # calibrated value, not just the wiring
+            os.environ["PARMMG_GROUP_CHUNK"] = "2"
+            _, _, st2, _ = run_grouped("1")
+            os.environ["PARMMG_GROUP_CHUNK"] = "0"
             group_sched = {
-                "adapt_s": round(time.perf_counter() - t0, 3),
-                "dispatches": st_g.group_dispatches,
-                "saved_dispatches": st_g.group_dispatches_saved,
-                "groups_skipped": st_g.groups_skipped,
+                "ngroups": ngr,
+                "cycles": st1.cycles,
+                "mask_off_adapt_s": round(t_off, 3),
+                "mask_on_adapt_s": round(t_on, 3),
+                "mask_off_s_per_cycle":
+                    round(t_off / max(st0.cycles, 1), 4),
+                "mask_on_s_per_cycle":
+                    round(t_on / max(st1.cycles, 1), 4),
+                "cond_skipped_rows":
+                    st1.sched_extra.get("cond_skipped_rows", 0),
+                "dispatches": st1.group_dispatches,
+                "saved_dispatches": st1.group_dispatches_saved,
                 "active_groups_per_block":
-                    st_g.sched_extra.get("active_groups_per_block", []),
-                "pipeline_s": {
-                    k: round(v, 4)
-                    for k, v in st_g.sched_extra.items()
-                    if k.startswith("grp_")},
+                    st1.sched_extra.get("active_groups_per_block", []),
+                # measured on the chunked (chunk=2) pipeline run
+                "chunk_overhead_units":
+                    st2.sched_extra.get("chunk_overhead_units", []),
+                "chunked_saved_dispatches": st2.group_dispatches_saved,
+                "chunked_cond_skipped":
+                    st2.sched_extra.get("cond_skipped_rows", 0),
+                "parity_ok": parity_ok,
             }
         finally:
-            if chunk_prev is None:
-                os.environ.pop("PARMMG_GROUP_CHUNK", None)
+            for k, v in prev_env.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+
+    # one-pass phase-timing capture (scripts/profile_adapt.py --json):
+    # committed into the artifact so the next chip session can diff the
+    # SAME phase names on a real device timeline
+    profile_phases = None
+    pp = os.environ.get("BENCH_PROFILE_JSON", "")
+    if pp and os.path.exists(pp):
+        with open(pp) as f:
+            profile_phases = json.load(f)
 
     # ledger regression check against the previous round's artifact:
     # any entry point whose compiled-variant count GREW since the last
@@ -352,11 +436,14 @@ def main() -> None:
                "sum_rate": round(mtets_sum, 4),
                "narrow_cycles": narrow_cycles,
                "aniso": aniso,
-               # grouped-analysis double-extraction cost (seconds per
-               # [12*capT] extraction at this mesh shape) + the
-               # quiet-group scheduler datapoint (BENCH_GROUPED=1)
-               "extract2x_s": extract2x_s,
+               # single [12*capT] extraction cost (= the per-group
+               # per-refresh saving of the PR-12 grouped-analysis
+               # fusion; replaces the retired extract2x_s) + the
+               # device-mask before/after datapoint (BENCH_GROUPED=1)
+               "extract1x_s": extract1x_s,
                "group_sched": group_sched,
+               "parity_ok": parity_ok,
+               "profile_phases": profile_phases,
                "device": str(jax.devices()[0].platform),
                "fallback": os.environ.get(
                    "PARMMG_BENCH_FALLBACK", "") == "1",

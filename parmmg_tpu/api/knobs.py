@@ -59,6 +59,11 @@ KNOBS: dict[str, Knob] = {
         "int", "",
         "override cycles per compiled adapt block (ops/adapt.py); "
         "empty = backend default"),
+    "PARMMG_DEVICE_MASK": Knob(
+        "flag", "1",
+        "device-resident quiet masks: lax.cond-skip the wave math for "
+        "quiet/pad group slots on the grouped and dist paths "
+        "(parallel/sched.py); 0 = compute every slot"),
     "PARMMG_FAULT": Knob(
         "spec", "",
         "arm fault-injection sites: site[:trigger][,site...] "

@@ -105,7 +105,7 @@ def adapt_cycle_impl(mesh: Mesh, met: jax.Array, wave: jax.Array,
                      budget_div: int = 8,
                      et0=None, vact=None, submesh: bool = False,
                      wide: bool = False, wwin=None,
-                     prescreen: bool = True):
+                     prescreen: bool = True, active=None):
     """One adaptation cycle: split -> collapse -> [swap] -> [smooth].
 
     Pure jittable function (jitted wrapper below) — also the compile-check
@@ -139,8 +139,37 @@ def adapt_cycle_impl(mesh: Mesh, met: jax.Array, wave: jax.Array,
     full refresh + polish, so narrow does not escalate on it
     (ops/active.py).  ``narrow_abort`` is always 0 on this full-width
     path.
+
+    ``active``: optional traced scalar bool — the device-resident
+    quiet-mask hook of the grouped paths (parallel/sched.py).  When
+    given, the WHOLE cycle is wrapped in ``lax.cond``: an inactive
+    group slot returns its state unchanged with zero op counts (live
+    count still reported), so a ``lax.map`` group body skips the
+    split/collapse/swap/smooth wave math for slots the scheduler
+    already proved quiet — exact by the frozen-seam + deterministic-
+    wave fixed-point argument (re-running any weaker-or-equal block on
+    a zero-op state is byte-identity, so returning the input IS the
+    recompute).  ``active=None`` compiles the unconditional body — the
+    whole-mesh path is untouched.
     """
     from .adjacency import boundary_edge_tags
+    if active is not None:
+        def _run(ops):
+            m, k = ops
+            return adapt_cycle_impl(
+                m, k, wave, do_swap=do_swap, do_smooth=do_smooth,
+                smooth_waves=smooth_waves, do_insert=do_insert,
+                final_rebuild=final_rebuild, hausd=hausd,
+                budget_div=budget_div, et0=et0, vact=vact,
+                submesh=submesh, wide=wide, wwin=wwin,
+                prescreen=prescreen)
+
+        def _skip(ops):
+            m, k = ops
+            counts = jnp.zeros(8, jnp.int32).at[5].set(
+                jnp.sum(m.tmask, dtype=jnp.int32))
+            return m, k, counts
+        return jax.lax.cond(active, _run, _skip, (mesh, met))
     defer = jnp.zeros((), bool)
     defer_sw = jnp.zeros((), bool)
     if do_insert:
@@ -358,16 +387,34 @@ def default_cycle_block(x=None) -> int:
 def sliver_polish_impl(mesh: Mesh, met: jax.Array, wave: jax.Array,
                        sliver_q: float = 0.2, do_collapse: bool = True,
                        do_swap: bool = True, do_smooth: bool = True,
-                       hausd: float | None = None):
+                       hausd: float | None = None, active=None):
     """Bad-element optimization pass (MMG3D_opttyp analogue): quality-
     targeted collapses on tets below ``sliver_q``, then swaps and a
     smoothing wave.  Run after the sizing loop converges — length-driven
     waves leave near-degenerate tets whose edges are all 'nice' lengths.
     The do_* switches mirror -noinsert/-noswap/-nomove.
 
+    ``active``: optional traced scalar bool — same device-resident
+    quiet-mask hook as :func:`adapt_cycle_impl`: an inactive group slot
+    (a retired group of the wave-major grouped polish, or a padded tail
+    row of a compacted chunk plan) returns its state unchanged with
+    zero counts instead of running the collapse/swap/smooth math.
+
     Returns (mesh, counts[4] = [ncollapse, nswap, nmoved, live_tets]).
     """
     from .adjacency import boundary_edge_tags
+    if active is not None:
+        def _run(m):
+            return sliver_polish_impl(
+                m, met, wave, sliver_q=sliver_q,
+                do_collapse=do_collapse, do_swap=do_swap,
+                do_smooth=do_smooth, hausd=hausd)
+
+        def _skip(m):
+            counts = jnp.zeros(4, jnp.int32).at[3].set(
+                jnp.sum(m.tmask, dtype=jnp.int32))
+            return m, counts
+        return jax.lax.cond(active, _run, _skip, mesh)
     ncol = jnp.zeros((), jnp.int32)
     nswap = jnp.zeros((), jnp.int32)
     nmoved = jnp.zeros((), jnp.int32)

@@ -11,9 +11,24 @@ a crash here costs the quality tail, not the run (the caller treats a
 non-zero exit as "skip grouped polish" and falls back to the merged
 CPU polish).
 
-Protocol: argv[1] = input .npz (stacked Mesh leaves + met + knobs),
-argv[2] = output .npz (updated tet-axis leaves + met).  Invoked by
-``parallel.groups.grouped_adapt_pass`` via ``sys.executable -m``.
+Polish schedule (PR 12, ROADMAP 1c — the quiet-group scheduler's
+wave-major compacted loop ported to this TPU-tunnel path): instead of
+the legacy per-chunk ladder (each chunk resident through up to 4 waves
+with a CHUNK-coupled break, so one busy chunk-mate extended a quiet
+group's wave count), the still-active group indices are compacted into
+dense ``[chunk]`` plans each wave (sched.chunk_plans) and every group
+retires at its OWN collapse+swap==0 fixed point; repeat-padded tail
+rows are lax.cond-skipped on device (sched.pad_mask — the same
+device-resident quiet-mask machinery as the in-session path).  Each
+wave's dispatches reuse the one compiled [chunk, ...] program.
+``PARMMG_GROUP_SCHED=0`` (inherited from the parent env) keeps the
+legacy per-chunk ladder here too, mirroring the in-session escape
+hatch.
+
+Protocol: argv[1] = input .npz (stacked Mesh leaves + met + knobs +
+ngroups), argv[2] = output .npz (updated tet-axis leaves + met).
+Invoked by ``parallel.groups.grouped_adapt_pass`` via
+``sys.executable -m``.
 """
 from __future__ import annotations
 
@@ -56,6 +71,8 @@ def main(inp: str, outp: str) -> None:
     from ..core.mesh import Mesh
     from ..ops.adapt import sliver_polish_impl
 
+    from .sched import chunk_plans, pad_mask, sched_enabled
+
     z = np.load(inp)
     stacked = Mesh(**{f: z[f] for f in MESH_FIELDS})
     met_s = z["met"]
@@ -64,6 +81,10 @@ def main(inp: str, outp: str) -> None:
                                 bool(z["nomove"]))
     hausd = float(z["hausd"]) if np.isfinite(z["hausd"]) else None
     g_exec = stacked.vert.shape[0]
+    # real group count: pad groups (dead at birth) never enter the
+    # active set at all.  Absent on old hand-over files -> treat every
+    # slot as real (pads retire at wave 0 with zero counts anyway).
+    ngroups = int(z["ngroups"]) if "ngroups" in z.files else g_exec
     met_s = np.array(met_s)
     stacked = dataclasses.replace(
         stacked, **{f: np.array(getattr(stacked, f))
@@ -73,36 +94,74 @@ def main(inp: str, outp: str) -> None:
     # process, so this jit object lives exactly as long as the process
     # (the persistent compile cache shares the executable across runs)
     @jax.jit
-    def polish_block(stacked, met_s, wave):
+    def polish_block(stacked, met_s, wave, active):
         def body(args):
-            m, k, w = args
+            m, k, w, a = args
             m, cnt = sliver_polish_impl(
                 m, k, w, do_collapse=not noinsert, do_swap=not noswap,
-                do_smooth=not nomove, hausd=hausd)
+                do_smooth=not nomove, hausd=hausd, active=a)
             return m, k, cnt
         waves = jnp.full(stacked.vert.shape[0], wave, jnp.int32)
-        return jax.lax.map(body, (stacked, met_s, waves))
+        return jax.lax.map(body, (stacked, met_s, waves, active))
 
-    for g0 in range(0, g_exec, chunk):
-        sl = jax.tree.map(lambda a: jnp.asarray(a[g0:g0 + chunk]),
-                          stacked)
-        kl = jnp.asarray(met_s[g0:g0 + chunk])
+    if sched_enabled():
+        # wave-major compacted polish (module docstring): each group
+        # retires at its OWN collapse+swap==0 fixed point; per-wave
+        # plans gather only the still-active groups, pad rows
+        # cond-skipped
+        pol_act = np.arange(ngroups)
         for w in range(4):
-            sl, kl, cnt = polish_block(sl, kl,
-                                       jnp.asarray(2000 + w, jnp.int32))
-            tot = np.asarray(cnt).sum(axis=0)
+            if not len(pol_act):
+                break
+            parts = []
+            for idx, nreal in chunk_plans(pol_act, chunk):
+                sl = jax.tree.map(lambda a: jnp.asarray(a[idx]),
+                                  stacked)
+                kl = jnp.asarray(met_s[idx])
+                act = jnp.asarray(pad_mask(len(idx), nreal))
+                sl, kl, cnt = polish_block(
+                    sl, kl, jnp.asarray(2000 + w, jnp.int32), act)
+                rows = idx[:nreal]
+                for f in MESH_FIELDS:
+                    getattr(stacked, f)[rows] = np.asarray(
+                        getattr(sl, f))[:nreal]
+                met_s[rows] = np.asarray(kl)[:nreal]
+                parts.append(np.asarray(cnt)[:nreal])
+            cnts = np.concatenate(parts)              # [n_act, 4]
+            tot = cnts.sum(axis=0)
             # lint: ok(R3) — worker->parent stderr protocol: the parent
             # captures this stream and relays it via obs.trace.log at
             # its own verbosity (groups.py polish-worker invocation)
-            print(f"polish chunk {g0 // chunk} w{w}: "
-                  f"collapse {int(tot[0])} swap {int(tot[1])} "
-                  f"move {int(tot[2])}", file=sys.stderr, flush=True)
-            if int(tot[0]) == 0 and int(tot[1]) == 0:
-                break
-        for f in MESH_FIELDS:
-            getattr(stacked, f)[g0:g0 + chunk] = np.asarray(
-                getattr(sl, f))
-        met_s[g0:g0 + chunk] = np.asarray(kl)
+            print(f"polish w{w}: collapse {int(tot[0])} "
+                  f"swap {int(tot[1])} move {int(tot[2])} over "
+                  f"{len(pol_act)} active groups",
+                  file=sys.stderr, flush=True)
+            pol_act = pol_act[(cnts[:, 0] + cnts[:, 1]) > 0]
+    else:
+        # PARMMG_GROUP_SCHED=0 escape hatch: the legacy per-chunk wave
+        # ladder (each chunk resident through up to 4 waves with a
+        # chunk-coupled break), bit-identical to the pre-wave-major
+        # worker — the same compiled polish_block with an all-true mask
+        for g0 in range(0, g_exec, chunk):
+            sl = jax.tree.map(lambda a: jnp.asarray(a[g0:g0 + chunk]),
+                              stacked)
+            kl = jnp.asarray(met_s[g0:g0 + chunk])
+            for w in range(4):
+                sl, kl, cnt = polish_block(
+                    sl, kl, jnp.asarray(2000 + w, jnp.int32),
+                    jnp.ones(sl.vert.shape[0], bool))
+                tot = np.asarray(cnt).sum(axis=0)
+                # lint: ok(R3) — worker->parent stderr protocol (above)
+                print(f"polish chunk {g0 // chunk} w{w}: "
+                      f"collapse {int(tot[0])} swap {int(tot[1])} "
+                      f"move {int(tot[2])}", file=sys.stderr,
+                      flush=True)
+                if int(tot[0]) == 0 and int(tot[1]) == 0:
+                    break
+            for f in MESH_FIELDS:
+                getattr(stacked, f)[g0:g0 + chunk] = np.asarray(
+                    getattr(sl, f))
+            met_s[g0:g0 + chunk] = np.asarray(kl)
 
     np.savez(outp, met=met_s,
              **{f: getattr(stacked, f) for f in MESH_FIELDS})

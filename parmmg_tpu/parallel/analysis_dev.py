@@ -46,9 +46,13 @@ two collectives on interface-sized data: one ``all_gather`` of the
 [G, KS] shared-record packs (logical shard l = device*G + slot) and one
 grouped node-comm halo exchange (:func:`comms.halo_exchange_grouped`,
 or its per-device-pair packed variant when the neighbor table is
-sparse).  The per-group record extraction runs twice (once to pack,
-once in the tail) — cheap gathers, traded for never persisting a
-[G, 12*capT] intermediate across the map.
+sparse).  The per-group record extraction runs ONCE (fused, PR 12):
+the pack phase also computes the local verdicts and carries the
+per-record bits ([G, 12*capT] uint32 + head bool — 5 bytes/record)
+across the map, and the tail re-derives only the cheap endpoint/slot
+gathers instead of re-running the normals + global-id extraction (the
+``extract2x_s`` decision input that priced this, retired in favor of
+the bench's ``extract1x_s`` single-extraction timing).
 """
 from __future__ import annotations
 
@@ -139,26 +143,39 @@ class _Records(NamedTuple):
     sh_rec: jax.Array      # [R] potentially-shared record
 
 
-def _extract_records(mesh: Mesh, glo) -> _Records:
+def _extract_records(mesh: Mesh, glo=None) -> _Records:
     """Extract the [R] record table (the rank-local half of the
-    reference's analys exchange)."""
+    reference's analys exchange).
+
+    ``glo=None`` extracts the LIGHT table: endpoint/slot fields only
+    (la/lb/valid/trow/le — cheap index gathers), with the
+    normal/ref/global-id/interface fields zeroed.  The fused grouped
+    analysis (:func:`shard_analysis_body_grouped`) runs the FULL
+    extraction exactly once per group (pack phase) and carries the
+    verdict bits across the map; its tail re-derives only this light
+    table — the cross products, normalization, global-id and
+    interface-classification gathers of the second extraction are the
+    work the fusion removed (the retired ``extract2x_s`` cost)."""
     capT, capP = mesh.capT, mesh.capP
     idir = jnp.asarray(IDIR)
-    glo_i = glo.astype(jnp.int32)
+    full = glo is not None
+    glo_i = glo.astype(jnp.int32) if full else None
     la_l, lb_l, valid_l, nrm_l, fref_l, trow_l, le_l = \
         [], [], [], [], [], [], []
     for f in range(4):
         tri = mesh.tet[:, idir[f]]                        # [T,3]
-        p = mesh.vert[tri]
-        nrm = jnp.cross(p[:, 1] - p[:, 0], p[:, 2] - p[:, 0])
+        if full:
+            p = mesh.vert[tri]
+            nrm = jnp.cross(p[:, 1] - p[:, 0], p[:, 2] - p[:, 0])
         is_b = mesh.tmask & ((mesh.ftag[:, f] & MG_BDY) != 0) & \
             ((mesh.ftag[:, f] & MG_PARBDY) == 0)
         for (a, b) in _EDGE_PAIRS:
             la_l.append(tri[:, a])
             lb_l.append(tri[:, b])
             valid_l.append(is_b)
-            nrm_l.append(nrm)
-            fref_l.append(mesh.fref[:, f])
+            if full:
+                nrm_l.append(nrm)
+                fref_l.append(mesh.fref[:, f])
             trow_l.append(jnp.arange(capT, dtype=jnp.int32))
             from ..ops.swap import _EDGE_OF
             # lint: ok(R2) — _EDGE_OF is a static host table; the int()
@@ -168,12 +185,18 @@ def _extract_records(mesh: Mesh, glo) -> _Records:
     la = jnp.concatenate(la_l)
     lb = jnp.concatenate(lb_l)
     valid = jnp.concatenate(valid_l)
+    trow = jnp.concatenate(trow_l)
+    le = jnp.concatenate(le_l)
+    R = la.shape[0]
+    if not full:
+        zi = jnp.zeros(R, jnp.int32)
+        return _Records(la, lb, valid, jnp.zeros((R, 3), mesh.vert.dtype),
+                        zi, trow, le, zi, zi,
+                        jnp.zeros(R, bool), jnp.zeros(R, bool))
     nrm = jnp.concatenate(nrm_l)
     nu = nrm / jnp.maximum(
         jnp.linalg.norm(nrm, axis=-1, keepdims=True), 1e-30)
     frf = jnp.concatenate(fref_l)
-    trow = jnp.concatenate(trow_l)
-    le = jnp.concatenate(le_l)
     ga = glo_i[jnp.clip(la, 0, capP - 1)]
     gb = glo_i[jnp.clip(lb, 0, capP - 1)]
     g_lo = jnp.minimum(ga, gb)
@@ -402,18 +425,34 @@ def shard_analysis_body_grouped(mesh_s: Mesh, glo_s, node_idx_s, nbr_s,
     grouped halo exchange (dense, or per-device-pair packed when
     ``packed_M`` is set).
 
+    **Fused single extraction** (PR 12, ROADMAP 4a): the [12*capT]
+    record extraction runs ONCE per group per refresh.  Phase 1 does
+    the full extraction AND the local sort/classification, carrying the
+    per-record verdict bits ([G, R] uint32 + the [G, R] head-row bool —
+    5 bytes/record, vs the ~50-byte full record row the old design
+    refused to persist) across the map; the tail re-derives only the
+    cheap endpoint/slot gathers (light ``_extract_records``).  The
+    predecessor extracted twice to keep the cross-map intermediate at
+    [G, KS]; the ``extract2x_s`` probe priced that redundant second
+    extraction at ~G x one extraction per refresh, which bought this
+    trade (bench ``extract1x_s`` = the measured per-group saving).
+
     Returns (vtag_new [G, capP], etag_new [G, capT, 6], overflow bool).
     """
     from .comms import halo_exchange_grouped, halo_exchange_grouped_packed
     capP = mesh_s.vert.shape[1]
 
-    # ---- phase 1 (per group, lax.map): shared-record packs --------------
+    # ---- phase 1 (per group, lax.map): ONE full extraction — local
+    # verdicts + shared-record packs + the [G, R] verdict carry ----------
     def pack_one(args):
         mesh_g, glo_g = args
-        pack, ovf = _shared_pack(_extract_records(mesh_g, glo_g), KS)
-        return pack, ovf
+        rec = _extract_records(mesh_g, glo_g)
+        bits_rec, head_rec = _local_bits(rec, angedg)
+        pack, ovf = _shared_pack(rec, KS)
+        return pack, ovf, bits_rec, head_rec
 
-    packs, ovf_g = jax.lax.map(pack_one, (mesh_s, glo_s))   # [G, KS, ...]
+    packs, ovf_g, bits_all, head_all = \
+        jax.lax.map(pack_one, (mesh_s, glo_s))              # [G, ...]
     ovf = jnp.any(ovf_g)
 
     # ---- phase 2: one all_gather + the global grouping ------------------
@@ -447,19 +486,22 @@ def shard_analysis_body_grouped(mesh_s: Mesh, glo_s, node_idx_s, nbr_s,
     ovf = jax.lax.pmax(ovf.astype(jnp.int32), axis_name) > 0
 
     # ---- phase 3 (per group, lax.map): verdict merge + local tail -------
+    # (light re-extraction only: the verdict bits and the pack-slot
+    # mapping were carried from phase 1 — no second full extraction)
     def tail_one(args):
-        mesh_g, glo_g, sh_bits_g, sh_head_g = args
-        rec = _extract_records(mesh_g, glo_g)
-        bits_rec, head_rec = _local_bits(rec, angedg)
-        pack, _ = _shared_pack(rec, KS)        # same widx order as phase 1
+        (mesh_g, bits_rec, head_rec, row_g, pv_g,
+         sh_bits_g, sh_head_g) = args
+        rec = _extract_records(mesh_g)                     # light
         bits_rec, head_rec = _merge_pack_verdicts(
-            bits_rec, head_rec, pack, sh_bits_g, sh_head_g)
+            bits_rec, head_rec, {"row": row_g, "valid": pv_g},
+            sh_bits_g, sh_head_g)
         payload = _vertex_payload(mesh_g, rec, bits_rec, head_rec)
         etag_new = _etag_rewrite(mesh_g, rec, bits_rec)
         return etag_new, payload
 
     etag_new, payload = jax.lax.map(
-        tail_one, (mesh_s, glo_s, sh_bits, sh_head))
+        tail_one, (mesh_s, bits_all, head_all, packs["row"],
+                   packs["valid"], sh_bits, sh_head))
 
     # ---- phase 4: grouped int-comm reduction + vertex classification ---
     if packed_M is not None:
@@ -487,13 +529,13 @@ def extract_probe_seconds(mesh_g: Mesh, glo_g, repeats: int = 3) -> float:
     """Wall-seconds for ONE [12*capT] record-table extraction, jitted
     standalone (compile excluded; median of ``repeats`` runs).
 
-    Decision input for the grouped-analysis fused-single-pass follow-on
-    (ROADMAP): :func:`dist_analysis_grouped` extracts the record table
-    TWICE per group per refresh (pack phase + tail phase) to avoid
-    persisting a [G, 12*capT] intermediate across the lax.map, so the
-    redundant extraction cost per refresh is ~ G x this number — and the
-    fused variant is justified (or dropped) by comparing it against the
-    refresh wall time.  Surfaced as ``extract2x_s`` in the bench extra.
+    PR 5 surfaced this as ``extract2x_s``, the decision input pricing
+    :func:`dist_analysis_grouped`'s redundant SECOND extraction (~G x
+    this number per refresh).  PR 12 fused the double extraction into
+    one pass (ROADMAP 4a) — the probe now prices what the fusion
+    REMOVED: before = 2x this per group per refresh, after = 1x plus
+    cheap endpoint gathers.  Surfaced as ``extract1x_s`` in the bench
+    extra (the measured before/after of the fusion).
 
     The probe reduces every record field to scalars so the measurement
     covers the full extraction (gathers + cross products + the
@@ -522,7 +564,12 @@ def extract_probe_seconds(mesh_g: Mesh, glo_g, repeats: int = 3) -> float:
         t0 = time.perf_counter()
         jax.block_until_ready(_EXTRACT_PROBE(mesh_g, glo_g))
         ts.append(time.perf_counter() - t0)
-    return float(np.median(ts))
+    sec = float(np.median(ts))
+    # obs spine: the fused-extraction timing rides the metrics registry
+    # too (the per-group per-refresh seconds the PR-12 fusion saves)
+    from ..obs.metrics import REGISTRY
+    REGISTRY.gauge("analysis.extract1x_s").set(sec)
+    return sec
 
 
 def dist_analysis(dmesh, angedg: float, KS: int):

@@ -84,7 +84,8 @@ def shard_stacked(stacked, dmesh: DeviceMesh):
 def dist_adapt_block(dmesh: DeviceMesh, swap_flags: tuple,
                      do_smooth: bool = True, do_insert: bool = True,
                      hausd: float | None = None, G: int = 1,
-                     pre_flags: tuple | None = None):
+                     pre_flags: tuple | None = None,
+                     swap_inclusive: bool | None = None):
     """SPMD fused cycle block: ``len(swap_flags)`` adapt cycles in ONE
     jitted shard_map program — the production analogue of
     ops.adapt.adapt_cycles_fused.  One dispatch + one psum'd counter
@@ -100,9 +101,9 @@ def dist_adapt_block(dmesh: DeviceMesh, swap_flags: tuple,
     group states plus a single group's wave working set — the bound
     that makes meshes far beyond one group's HBM feasible per chip.
 
-    Returns fn(stacked_mesh, stacked_met, wave0) ->
+    Returns fn(stacked_mesh, stacked_met, wave0, quiet_lvl[S*G]) ->
       (stacked_mesh, stacked_met, global_counts[n,4],
-       active_groups[n], any_overflow).
+       active_groups[n], any_overflow, quiet_lvl'[S*G]).
 
     ``active_groups[i]`` = number of LOGICAL shards that posted a
     nonzero split+collapse+swap in cycle i (psum'd like the counters):
@@ -111,45 +112,79 @@ def dist_adapt_block(dmesh: DeviceMesh, swap_flags: tuple,
     verbose "active g/G" trajectory from per-group data — the SPMD
     mirror of the quiet-group scheduler on the single-device grouped
     path (parallel/sched.py).
+
+    ``quiet_lvl`` is that scheduler's quiet state made DEVICE-RESIDENT
+    (int8 per logical shard, the sched.LEVEL_* ladder): a shard at or
+    above this block's skip level has its ``lax.map`` body wrapped in
+    ``lax.cond`` identity — the split/collapse/swap/smooth wave math is
+    never executed for it — and a swap-inclusive block posting zero
+    split+collapse+swap+move+overflow for a shard raises its level ON
+    DEVICE (the same frozen-seam + deterministic-wave fixed-point
+    proof, the same two prescreen levels; sched module docstring).
+    Zero host syncs are added: the level array never leaves the device.
+    ``swap_inclusive`` must be passed as ``any(flags) or noswap`` by
+    callers that honor -noswap (a noswap run's blocks are trivially
+    swap-inclusive); it defaults to ``any(swap_flags)``.  The caller
+    opts out of skipping by discarding the returned level and passing
+    zeros each block (run_adapt_cycles under PARMMG_DEVICE_MASK=0 /
+    PARMMG_GROUP_SCHED=0) — same compiled program either way.
     """
     from ..ops.adapt import adapt_cycle_impl
     spec = P("shard")
     if pre_flags is None:
         pre_flags = (True,) * len(swap_flags)
+    if swap_inclusive is None:
+        swap_inclusive = any(swap_flags)
+    # the level this block skips at == the level it can prove
+    # (sched.LEVEL_PRE under an all-prescreen-ON block, LEVEL_FULL once
+    # a prescreen-OFF cycle ran — numerically 1 and 2)
+    skip_lvl = 1 if all(pre_flags) else 2
 
-    def one_shard(mesh: Mesh, met, wave0):
+    def one_shard(mesh: Mesh, met, wave0, act):
         counts_all = []
         for c, dosw in enumerate(swap_flags):
             mesh, met, counts = adapt_cycle_impl(
                 mesh, met, wave0 + c, do_swap=dosw, do_smooth=do_smooth,
                 do_insert=do_insert, smooth_waves=2, hausd=hausd,
                 final_rebuild=(c == len(swap_flags) - 1),
-                prescreen=pre_flags[c])
+                prescreen=pre_flags[c], active=act)
             counts_all.append(counts)
         return mesh, met, jnp.stack(counts_all)            # [n, 8]
 
-    def local_block(mesh_s: Mesh, met_s, wave0):
+    def local_block(mesh_s: Mesh, met_s, wave0, lvl_s):
+        act_in = lvl_s < skip_lvl                          # [G] bool
         if G == 1:
-            mesh, met, cs = one_shard(_unstack(mesh_s), met_s[0], wave0)
+            mesh, met, cs = one_shard(_unstack(mesh_s), met_s[0],
+                                      wave0, act_in[0])
             mesh_s, met_s = _restack(mesh), met[None]
+            cs_g = cs[None]                                # [1, n, 8]
             act = (jnp.sum(cs[:, :3], axis=1) > 0).astype(jnp.int32)
         else:
             def body(args):
-                m, k = args
-                return one_shard(m, k, wave0)
-            mesh_s, met_s, cs_g = jax.lax.map(body, (mesh_s, met_s))
-            cs = jnp.sum(cs_g, axis=0)                     # [n, 8]
-            cs = cs.at[:, 4].set(jnp.max(cs_g[:, :, 4], axis=0))
+                m, k, a = args
+                return one_shard(m, k, wave0, a)
+            mesh_s, met_s, cs_g = jax.lax.map(
+                body, (mesh_s, met_s, act_in))
             act = jnp.sum((jnp.sum(cs_g[:, :, :3], axis=2) > 0
                            ).astype(jnp.int32), axis=0)    # [n]
-        ovf = jax.lax.pmax(jnp.max(cs[:, 4]), "shard")
-        counts = jax.lax.psum(cs[:, :4], "shard")
+        if swap_inclusive:
+            # quiet marking on device — sched.quiet_rows' rule: the
+            # WHOLE block a no-op (zero split+collapse+swap+move AND
+            # zero overflow; a truncated winner set witnesses nothing)
+            nG = cs_g.shape[0]
+            blk_zero = jnp.sum(cs_g[:, :, :5].reshape(nG, -1),
+                               axis=1) == 0
+            lvl_s = jnp.maximum(
+                lvl_s, jnp.where(blk_zero, jnp.int8(skip_lvl),
+                                 jnp.int8(0)))
+        ovf = jax.lax.pmax(jnp.max(cs_g[:, :, 4]), "shard")
+        counts = jax.lax.psum(jnp.sum(cs_g[:, :, :4], axis=0), "shard")
         nact = jax.lax.psum(act, "shard")
-        return mesh_s, met_s, counts, nact, ovf
+        return mesh_s, met_s, counts, nact, ovf, lvl_s
 
     fn = shard_map(local_block, mesh=dmesh,
-                   in_specs=(spec, spec, P()),
-                   out_specs=(spec, spec, P(), P(), P()),
+                   in_specs=(spec, spec, P(), spec),
+                   out_specs=(spec, spec, P(), P(), P(), spec),
                    check_vma=False)
     return governed("dist.adapt_block")(jax.jit(fn))
 
@@ -169,15 +204,19 @@ class DistSteps:
                        hausd=hausd, G=G)
         self._cache: dict = {}
 
-    def get(self, flags: tuple, pre_flags: tuple | None = None):
+    def get(self, flags: tuple, pre_flags: tuple | None = None,
+            swap_inclusive: bool | None = None):
         flags = tuple(bool(f) for f in flags)
         if pre_flags is None:
             pre_flags = (True,) * len(flags)
         pre_flags = tuple(bool(f) for f in pre_flags)
-        key = (flags, pre_flags)
+        if swap_inclusive is None:
+            swap_inclusive = any(flags)
+        key = (flags, pre_flags, bool(swap_inclusive))
         if key not in self._cache:
             self._cache[key] = dist_adapt_block(
-                self.dmesh, flags, pre_flags=pre_flags, **self.kw)
+                self.dmesh, flags, pre_flags=pre_flags,
+                swap_inclusive=swap_inclusive, **self.kw)
         return self._cache[key]
 
 
@@ -518,11 +557,20 @@ def run_adapt_cycles(stacked, met_s, steps: DistSteps, cycles,
     carried across calls so repeated passes share the regrow budget.
     """
     from .distribute import merge_shards, grow_shards
+    from .sched import device_mask_enabled, sched_enabled
     from ..ops.adapt import default_cycle_block
     if regrow_state is None:
         regrow_state = [0]
     if block is None:
         block = default_cycle_block(stacked.vert)
+    # device-resident quiet levels (the sched.py proof pushed into the
+    # compiled block — dist_adapt_block docstring): int8 per logical
+    # shard, never pulled to host.  With masking disabled the SAME
+    # program runs with an all-zeros level every block (no skipping, no
+    # new compile family).
+    n_logical = stacked.tmask.shape[0]
+    mask_on = sched_enabled() and device_mask_enabled()
+    lvl = shard_stacked(jnp.zeros(n_logical, jnp.int8), dmesh)
     c = 0
     while c < cycles:
         nblk = min(block, cycles - c)
@@ -534,9 +582,12 @@ def run_adapt_cycles(stacked, met_s, steps: DistSteps, cycles,
         flags = tuple((cc % 3 == 2 or cc >= cycles - 2) and not noswap
                       for cc in range(c, c + nblk))
         pres = tuple(cc < cycles - 2 for cc in range(c, c + nblk))
-        step = steps.get(flags, pres)
-        stacked, met_s, counts, nact, ovf = step(
-            stacked, met_s, jnp.asarray(c, jnp.int32))
+        step = steps.get(flags, pres,
+                         swap_inclusive=any(flags) or noswap)
+        stacked, met_s, counts, nact, ovf, lvl2 = step(
+            stacked, met_s, jnp.asarray(c, jnp.int32), lvl)
+        if mask_on:
+            lvl = lvl2
         ca = np.asarray(counts)                  # [nblk, 4]
         na = np.asarray(nact)                    # [nblk] active groups
         n_logical = stacked.tmask.shape[0]
@@ -570,6 +621,9 @@ def run_adapt_cycles(stacked, met_s, steps: DistSteps, cycles,
             if on_grow is not None:
                 on_grow(capP)
             regrow_state[0] += 1
+            # every quiet proof is stale at the new capacity (the top-K
+            # wave budgets scale with capT) — sched.on_regrow's rule
+            lvl = shard_stacked(jnp.zeros(n_logical, jnp.int8), dmesh)
             continue        # re-run the block: truncated winners rerun
         c += nblk
         # convergence: a swap-inclusive (or noswap) cycle on which

@@ -135,7 +135,16 @@ def _group_block(flags: tuple, pres: tuple, nomove: bool,
     """Fused cycle block for the group axis (lax.map body): one
     dispatch + one counter pull per block per outer step (ops.adapt
     adapt_cycles_fused analogue).  Cached by knobs so repeat passes
-    reuse the compiled program."""
+    reuse the compiled program.
+
+    The compiled program takes a per-slot ``active`` bool mask (the
+    device-resident quiet mask, parallel/sched.py): inactive slots —
+    quiet groups of an unchunked dispatch, repeat-padded tail rows of a
+    compacted chunk plan — return their state unchanged with zero
+    counts via ``lax.cond`` instead of running the wave math
+    (ops/adapt.py ``active=``).  The mask is ALWAYS an argument (an
+    all-true mask when masking is off), so toggling it mints zero new
+    compile families — the grouped_sched_gate contract."""
     from ..ops.adapt import adapt_cycle_impl
     from ..utils.compilecache import governed
     key = (flags, pres, nomove, noinsert, hausd)
@@ -143,7 +152,7 @@ def _group_block(flags: tuple, pres: tuple, nomove: bool,
         return _GROUP_BLOCK_CACHE[key]
 
     def body(args):
-        m, k, wave = args
+        m, k, wave, act = args
         counts_all = []
         for cc, dosw in enumerate(flags):
             # named_scope: XLA ops of each unrolled cycle carry the
@@ -153,7 +162,7 @@ def _group_block(flags: tuple, pres: tuple, nomove: bool,
                     m, k, wave + cc, do_swap=dosw,
                     do_smooth=not nomove, do_insert=not noinsert,
                     hausd=hausd, final_rebuild=(cc == len(flags) - 1),
-                    prescreen=pres[cc])
+                    prescreen=pres[cc], active=act)
             counts_all.append(counts)
         return m, k, jnp.stack(counts_all)       # [n, 6]
 
@@ -162,10 +171,11 @@ def _group_block(flags: tuple, pres: tuple, nomove: bool,
     # chunk to ONE shape family — growth past this is recompile churn
     @governed("groups.adapt_block", budget=6)
     @jax.jit
-    def run(stacked, met_s, wave):
+    def run(stacked, met_s, wave, active):
         n_map = stacked.vert.shape[0]            # chunk or g_exec
         waves = jnp.full(n_map, wave, jnp.int32)
-        m, k, counts = jax.lax.map(body, (stacked, met_s, waves))
+        m, k, counts = jax.lax.map(body,
+                                   (stacked, met_s, waves, active))
         return m, k, counts                      # counts [G, n, 6]
 
     _GROUP_BLOCK_CACHE[key] = run
@@ -175,7 +185,10 @@ def _group_block(flags: tuple, pres: tuple, nomove: bool,
 def _group_polish_block(noinsert: bool, noswap: bool, nomove: bool,
                         hausd):
     """Grouped sliver-polish block (sliver_polish per group under
-    lax.map), cached by knobs for the same jit-identity reason."""
+    lax.map), cached by knobs for the same jit-identity reason.  Takes
+    the same per-slot ``active`` mask as :func:`_group_block` — the
+    wave-major polish retires groups at their own collapse+swap==0
+    fixed point, and a retired/pad slot's row is cond-skipped."""
     from ..ops.adapt import sliver_polish_impl
     from ..utils.compilecache import governed
     key = (noinsert, noswap, nomove, hausd)
@@ -184,17 +197,17 @@ def _group_polish_block(noinsert: bool, noswap: bool, nomove: bool,
 
     @governed("groups.polish_block", budget=4)
     @jax.jit
-    def polish_block(stacked, met_s, wave):
+    def polish_block(stacked, met_s, wave, active):
         def body(args):
-            m, k, w = args
+            m, k, w, act = args
             m, cnt = sliver_polish_impl(
                 m, k, w, do_collapse=not noinsert,
                 do_swap=not noswap, do_smooth=not nomove,
-                hausd=hausd)
+                hausd=hausd, active=act)
             return m, k, cnt
         n_map = stacked.vert.shape[0]            # chunk or g_exec
         waves = jnp.full(n_map, wave, jnp.int32)
-        m, k, cnt = jax.lax.map(body, (stacked, met_s, waves))
+        m, k, cnt = jax.lax.map(body, (stacked, met_s, waves, active))
         return m, k, cnt
 
     _POLISH_BLOCK_CACHE[key] = polish_block
@@ -263,6 +276,7 @@ def _pipeline_chunks(fn, stacked, met_s, wave, plans, tim, done=None):
     import os
     from ..resilience.faults import faultpoint
     from ..resilience.recover import retry_call
+    from .sched import pad_mask
     depth = 2 if os.environ.get("PARMMG_GROUP_PIPELINE", "1") != "0" \
         else 1
     out = [None] * len(plans)
@@ -271,9 +285,13 @@ def _pipeline_chunks(fn, stacked, met_s, wave, plans, tim, done=None):
         with tim("upload"):
             sl = jax.tree.map(lambda a: jnp.asarray(a[idx]), stacked)
             kl = jnp.asarray(met_s[idx])
+            # device quiet mask: the repeat-padded tail rows compute
+            # nothing (lax.cond identity) — their results were always
+            # discarded at writeback (sched.pad_mask)
+            act = jnp.asarray(pad_mask(len(idx), nreal))
         faultpoint("dispatch.chunk", key=str(pi))
         with otrace.annotate(f"grp_dispatch_chunk{pi}"):
-            m, k, cnt = fn(sl, kl, wave)
+            m, k, cnt = fn(sl, kl, wave, act)
         return (pi, idx, nreal, m, k, cnt)
 
     # lint: ok(R2) — the pipeline's ONE designed sync point: chunked
@@ -351,9 +369,16 @@ def grouped_adapt_pass(mesh: Mesh, met, ngroups: int, cycles: int = 12,
     disable): per-group counts mark groups quiet once a swap-inclusive
     block is a no-op for them, and subsequent chunked dispatches gather
     only the ACTIVE indices — same compiled [chunk, ...] program, fewer
-    executions of it.  Skipping is bit-for-bit exact (frozen seams +
-    deterministic waves make a zero-op state a fixed point; see the
-    sched module docstring for the prescreen-level and regrow caveats).
+    executions of it.  The quiet proof is ALSO pushed down into the
+    compiled programs as a device-resident active mask
+    (PARMMG_DEVICE_MASK=0 to disable): every group-block dispatch takes
+    a per-slot bool mask and ``lax.cond``-skips the wave math for
+    inactive slots — quiet groups of an unchunked dispatch (where
+    compaction cannot change the dispatch shape) and the repeat-padded
+    tail rows of chunk plans.  Skipping is bit-for-bit exact either way
+    (frozen seams + deterministic waves make a zero-op state a fixed
+    point; see the sched module docstring for the prescreen-level and
+    regrow caveats).
     Chunked dispatches ride a double-buffered pipeline
     (:func:`_pipeline_chunks`); its upload/compute/download/writeback
     split lands in ``timers`` (driver reporting) and, with the
@@ -431,6 +456,7 @@ def grouped_adapt_pass(mesh: Mesh, met, ngroups: int, cycles: int = 12,
             if chunk:
                 parts = _pipeline_chunks(step, stacked, met_s, wave,
                                          plans, ltim)
+                sched.note_plan_pads(plans)
                 counts_act = np.concatenate(parts) if parts else \
                     np.zeros((0, nblk, 8), np.int32)
                 if sched.enabled:
@@ -439,7 +465,13 @@ def grouped_adapt_pass(mesh: Mesh, met, ngroups: int, cycles: int = 12,
                            f"{len(act)}/{g_exec} groups, {len(plans)} "
                            "dispatches", verbose=verbose)
             else:
-                stacked, met_s, counts = step(stacked, met_s, wave)
+                # unchunked: compaction cannot change the dispatch
+                # shape — the device-resident quiet mask is what skips
+                # converged groups here (lax.cond identity rows,
+                # sched.block_mask; bit-for-bit by the fixed point)
+                stacked, met_s, counts = step(
+                    stacked, met_s, wave,
+                    jnp.asarray(sched.block_mask(pres_all_on)))
                 counts_act = np.asarray(counts)  # [g_exec, nblk, 8]
         sched.record_block(act, counts_act, swap_inc, pres_all_on)
         # quiet groups contribute exact zeros (that is what marked them)
@@ -536,7 +568,7 @@ def grouped_adapt_pass(mesh: Mesh, met, ngroups: int, cycles: int = 12,
             td = tempfile.mkdtemp(prefix="parmmg_polish_")
             try:
                 inp, outp = f"{td}/in.npz", f"{td}/out.npz"
-                np.savez(inp, met=met_s, chunk=chunk,
+                np.savez(inp, met=met_s, chunk=chunk, ngroups=ngroups,
                          noinsert=noinsert, noswap=noswap, nomove=nomove,
                          hausd=(np.nan if hausd is None else hausd),
                          **{f: getattr(stacked, f) for f in MESH_FIELDS})
@@ -615,6 +647,7 @@ def grouped_adapt_pass(mesh: Mesh, met, ngroups: int, cycles: int = 12,
                     parts = _pipeline_chunks(
                         polish_block, stacked, met_s,
                         jnp.asarray(2000 + w, jnp.int32), plans, ltim)
+                    sched.note_plan_pads(plans)
                     cnts = np.concatenate(parts)      # [n_act, 4]
                     pol_traj.append(len(pol_act))
                     tot = cnts.sum(axis=0, dtype=np.int64)
@@ -649,7 +682,8 @@ def grouped_adapt_pass(mesh: Mesh, met, ngroups: int, cycles: int = 12,
                 kl = jnp.asarray(met_s[g0:g0 + chunk])
                 for w in range(4):
                     sl, kl, cnt = polish_block(
-                        sl, kl, jnp.asarray(2000 + w, jnp.int32))
+                        sl, kl, jnp.asarray(2000 + w, jnp.int32),
+                        jnp.ones(chunk, bool))
                     tot = np.asarray(cnt).sum(axis=0)
                     otrace.log(2, f"  grp polish chunk {g0 // chunk} "
                                   f"w{w}: collapse {int(tot[0])} swap "
@@ -662,7 +696,8 @@ def grouped_adapt_pass(mesh: Mesh, met, ngroups: int, cycles: int = 12,
         else:
             for w in range(4):
                 stacked, met_s, cnt = polish_block(
-                    stacked, met_s, jnp.asarray(2000 + w, jnp.int32))
+                    stacked, met_s, jnp.asarray(2000 + w, jnp.int32),
+                    jnp.ones(g_exec, bool))
                 tot = np.asarray(cnt).sum(axis=0)
                 otrace.log(2, f"  grp polish {w}: collapse "
                               f"{int(tot[0])} swap {int(tot[1])} move "
@@ -675,14 +710,23 @@ def grouped_adapt_pass(mesh: Mesh, met, ngroups: int, cycles: int = 12,
     # report) under a "grp <segment>" prefix
     # chunk auto-tune (ROADMAP 1b, lightweight): fold this pass's
     # active-group trajectory into a chunk recommendation for the NEXT
-    # pass — adopted only under PARMMG_GROUP_CHUNK=auto, logged always
-    from .sched import note_chunk_recommendation, recommend_group_chunk
-    chunk_rec = recommend_group_chunk(sched.active_per_block,
-                                      g_exec if chunk else ngroups)
+    # pass — adopted only under PARMMG_GROUP_CHUNK=auto, logged always.
+    # The cost model's overhead constant is CALIBRATED from this pass's
+    # measured pipeline segment timings when a chunked pipeline ran
+    # (sched.calibrate_dispatch_overhead; hand-set default otherwise)
+    from .sched import (calibrate_dispatch_overhead,
+                        note_chunk_recommendation, recommend_group_chunk)
+    overhead = calibrate_dispatch_overhead(ltim.acc, ltim.count, chunk) \
+        if chunk else None
+    chunk_rec = recommend_group_chunk(
+        sched.active_per_block, g_exec if chunk else ngroups,
+        dispatch_overhead=(1.0 if overhead is None else overhead))
     note_chunk_recommendation(chunk_rec)
     otrace.log(2, f"  grp chunk auto-tune: recommend "
                   f"PARMMG_GROUP_CHUNK={chunk_rec or 'unchunked'} "
-                  f"(current {chunk or 'unchunked'})", verbose=verbose)
+                  f"(current {chunk or 'unchunked'}, overhead "
+                  f"{'default' if overhead is None else round(overhead, 3)}"
+                  " group-units)", verbose=verbose)
     # metrics spine: the pass's scheduler counters + pipeline segment
     # seconds land in the process registry regardless of whether the
     # caller threaded a stats/timers object through
@@ -692,7 +736,12 @@ def grouped_adapt_pass(mesh: Mesh, met, ngroups: int, cycles: int = 12,
         sched.saved_dispatches)
     REGISTRY.counter("groups.group_blocks_skipped").inc(
         sched.skipped_group_blocks)
+    # group-slot executions the device-resident quiet mask cond-skipped
+    # (unchunked quiet slots + padded tail rows of chunk plans)
+    REGISTRY.counter("groups.cond_skipped").inc(sched.cond_skipped)
     REGISTRY.gauge("groups.chunk_recommendation").set(chunk_rec)
+    if overhead is not None:
+        REGISTRY.gauge("groups.chunk_overhead_units").set(overhead)
     for k, v in ltim.acc.items():
         # lint: ok(R6) — k ranges over the fixed _pipeline_chunks
         # segment set (upload/compute/download/writeback): bounded
@@ -702,7 +751,12 @@ def grouped_adapt_pass(mesh: Mesh, met, ngroups: int, cycles: int = 12,
         stats.group_dispatches_saved += sched.saved_dispatches
         stats.groups_skipped += sched.skipped_group_blocks
         se = stats.sched_extra
+        se["cond_skipped_rows"] = se.get("cond_skipped_rows", 0) + \
+            sched.cond_skipped
         se.setdefault("chunk_recommendation", []).append(chunk_rec)
+        if overhead is not None:
+            se.setdefault("chunk_overhead_units", []).append(
+                round(overhead, 4))
         se.setdefault("active_groups_per_block", []).extend(
             sched.active_per_block)
         if pol_traj:

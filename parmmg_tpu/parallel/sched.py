@@ -44,7 +44,38 @@ Exactness contract (why skipping is bit-for-bit, not approximate):
   are fixed points by construction (all masks False) and are never
   dispatched.
 
-``PARMMG_GROUP_SCHED=0`` is the escape hatch back to always-dispatch.
+**Device-resident quiet masks** (PR 12, ROADMAP 1a): host-side
+compaction makes quiet groups cost zero *dispatches*; the device mask
+makes them cost ~zero *on device* too.  Every grouped block program
+(`groups._group_block`, `groups._group_polish_block`, the dist-path
+`dist.dist_adapt_block`) takes a per-slot bool mask and wraps its
+``lax.map`` group body in ``lax.cond`` (ops/adapt.py ``active=``): an
+inactive slot returns its state unchanged with zero counts instead of
+running the split/collapse/swap/smooth wave math.  This is exact by the
+SAME fixed-point argument as dispatch skipping — a quiet state's
+recompute IS the identity — and carries the same two proof levels:
+:meth:`QuietGroupScheduler.block_mask` masks ``level >= LEVEL_PRE``
+slots only under prescreen-ON blocks and ``level >= LEVEL_FULL`` slots
+under any block.  Three mask sources:
+
+- **unchunked dispatches** (``PARMMG_GROUP_CHUNK=0``, where compaction
+  cannot change the dispatch shape): ``block_mask`` — the only skip
+  mechanism this layout has;
+- **padded tail rows** of compacted chunk plans (:func:`pad_mask` via
+  ``groups._pipeline_chunks``): the repeat-padded duplicate rows used
+  to compute redundantly and be discarded at writeback — now they are
+  cond-skipped (serving cohorts included);
+- **the SPMD dist path** (``dist.run_adapt_cycles``): a per-logical-
+  shard quiet level lives ON DEVICE (int8, threaded through the block
+  program, updated by the same swap-inclusive zero-count rule) so the
+  G>1 ``lax.map`` body skips converged groups with zero host syncs.
+
+The mask is ALWAYS an argument of the compiled programs (an all-true
+mask when disabled), so toggling it mints zero new compile families —
+asserted by the ``run_tests.sh --ledger`` grouped_sched_gate.
+``PARMMG_DEVICE_MASK=0`` disables the on-device skipping;
+``PARMMG_GROUP_SCHED=0`` is the escape hatch back to always-dispatch
+(and also forces all-true masks).
 """
 from __future__ import annotations
 
@@ -59,6 +90,30 @@ def sched_enabled() -> bool:
     """PARMMG_GROUP_SCHED knob (default on)."""
     import os
     return os.environ.get("PARMMG_GROUP_SCHED", "1") != "0"
+
+
+def device_mask_enabled() -> bool:
+    """PARMMG_DEVICE_MASK knob (default on): device-resident quiet
+    masks — ``lax.cond``-skip the wave math for quiet/pad group slots
+    (module docstring).  0 = compute every slot (masks all-true; same
+    compiled programs)."""
+    import os
+    return os.environ.get("PARMMG_DEVICE_MASK", "1") != "0"
+
+
+def pad_mask(chunk: int, nreal: int) -> np.ndarray:
+    """[chunk] bool device-mask for a compacted chunk plan: the first
+    ``nreal`` rows are real, the repeat-padded tail rows are masked off
+    (their compute was always discarded at writeback — chunk_plans).
+    All-true when PARMMG_DEVICE_MASK=0 — or under the
+    PARMMG_GROUP_SCHED=0 escape hatch, which forces the full legacy
+    behavior (module docstring) — so the disabled path computes
+    exactly what it always did."""
+    if not (sched_enabled() and device_mask_enabled()):
+        return np.ones(chunk, bool)
+    m = np.zeros(chunk, bool)
+    m[:nreal] = True
+    return m
 
 
 def quiet_rows(counts: np.ndarray) -> np.ndarray:
@@ -76,15 +131,17 @@ def quiet_rows(counts: np.ndarray) -> np.ndarray:
 
 
 def chunk_plans(act: np.ndarray, chunk: int) -> list:
-    """Compact active group indices into dense [chunk]-sized plans.
+    """Compact active group indices (an ndarray) into dense
+    [chunk]-sized plans.
 
     Returns [(idx_exec [chunk], nreal)]: a short tail plan is padded by
     repeating its last real index so every dispatch keeps the compiled
-    [chunk, ...] shape; the duplicate rows compute the same result and
-    only the first ``nreal`` rows are written back."""
+    [chunk, ...] shape; the duplicate rows are masked off on device
+    (:func:`pad_mask`) and only the first ``nreal`` rows are written
+    back."""
     plans = []
     for i in range(0, len(act), chunk):
-        idx = np.asarray(act[i:i + chunk])
+        idx = act[i:i + chunk]
         nreal = len(idx)
         if nreal < chunk:
             idx = np.concatenate(
@@ -110,11 +167,17 @@ class QuietGroupScheduler:
         self.chunk = int(chunk)
         # compaction needs per-chunk dispatches to have fewer of them
         self.enabled = bool(enabled) and self.chunk > 0
+        # the device mask works at ANY chunking (including unchunked,
+        # where it is the only skip mechanism — module docstring)
+        self.mask_on = bool(enabled) and device_mask_enabled()
         self.level = np.zeros(self.g_exec, np.int8)
         self.level[self.ngroups:] = LEVEL_FULL     # dead pad groups
         self.dispatches = 0
         self.saved_dispatches = 0
         self.skipped_group_blocks = 0
+        # group-slot executions skipped ON DEVICE by the lax.cond mask
+        # (unchunked quiet slots + padded tail rows of chunk plans)
+        self.cond_skipped = 0
         self.active_per_block: list[int] = []
 
     # ---- block planning --------------------------------------------------
@@ -149,8 +212,32 @@ class QuietGroupScheduler:
         # ...but the skipped-GROUP counter reports convergence, so it
         # counts REAL groups only (pads are dead at birth, not wins)
         self.skipped_group_blocks += \
-            self.ngroups - int(np.sum(np.asarray(act) < self.ngroups))
+            self.ngroups - int(np.sum(act < self.ngroups))
         return act, plans
+
+    def block_mask(self, pres_all_on: bool) -> np.ndarray:
+        """[g_exec] bool device-mask for an UNCHUNKED dispatch: quiet
+        slots at or above this block's skip level are cond-skipped on
+        device (the only skip mechanism when compaction cannot change
+        the dispatch shape).  All-true when the mask is disabled.
+        Accounts the skipped slots in ``cond_skipped``."""
+        if not self.mask_on:
+            return np.ones(self.g_exec, bool)
+        m = self.level < self._skip_level(pres_all_on)
+        # lint: ok(R2) — m is the host scheduler state (numpy bool);
+        # counting the masked slots syncs nothing
+        self.cond_skipped += int(np.sum(~m))
+        return m
+
+    def note_plan_pads(self, plans: list) -> None:
+        """Account the repeat-padded tail rows of compacted chunk plans
+        that the device mask skipped (``pad_mask`` — one entry per
+        padded row per dispatch).  No-op whenever ``pad_mask`` returns
+        all-true (mask off, or the sched=0 escape hatch)."""
+        if not (sched_enabled() and device_mask_enabled()):
+            return
+        for idx, nreal in plans:
+            self.cond_skipped += len(idx) - nreal
 
     # ---- quiet marking ---------------------------------------------------
     def record_block(self, act: np.ndarray, counts: np.ndarray,
@@ -191,6 +278,37 @@ class QuietGroupScheduler:
 # ---------------------------------------------------------------------------
 # PARMMG_GROUP_CHUNK auto-tune (ROADMAP item 1b, lightweight host side)
 # ---------------------------------------------------------------------------
+def calibrate_dispatch_overhead(acc: dict, count: dict,
+                                chunk: int) -> float | None:
+    """Measured per-dispatch overhead in GROUP-COMPUTE UNITS from the
+    ``_pipeline_chunks`` segment timings (the PR-8 Timers spans) — the
+    calibration that replaces :func:`recommend_group_chunk`'s hand-set
+    ``dispatch_overhead=1.0`` default (ROADMAP 1b validation, host
+    side).
+
+    ``acc``/``count`` are the local pipeline registry's accumulators
+    (keys upload/compute/download/writeback; one count per dispatch).
+    overhead = (upload + download + writeback seconds per dispatch) /
+    (compute seconds per GROUP) — i.e. how many groups' worth of
+    compute one extra dispatch costs, exactly the unit the cost model
+    ``ceil(a/c) * (c + overhead)`` wants.  Under the double-buffered
+    pipeline the recorded compute segment is the RESIDUAL stall (the
+    overlap hides part of it), which biases the per-group unit low and
+    the overhead HIGH — i.e. toward larger chunks, the direction that
+    cannot recommend pathological tiny dispatches.  Returns ``None``
+    when the segments carry no signal (no dispatches, zero compute) —
+    the caller keeps the hand-set default then."""
+    disp = count.get("compute", 0)
+    if not disp or chunk <= 0:
+        return None
+    over = (acc.get("upload", 0.0) + acc.get("download", 0.0)
+            + acc.get("writeback", 0.0)) / disp
+    comp = acc.get("compute", 0.0) / disp / chunk
+    if comp <= 0.0 or over <= 0.0:
+        return None
+    return over / comp
+
+
 def recommend_group_chunk(traj, g_exec: int,
                           dispatch_overhead: float = 1.0) -> int:
     """Recommend a PARMMG_GROUP_CHUNK from a recorded
@@ -199,12 +317,16 @@ def recommend_group_chunk(traj, g_exec: int,
     Cost model per block with ``a`` active groups at chunk ``c``:
     ``ceil(a/c) * (c + dispatch_overhead)`` in group-compute units —
     every dispatch ships a full [c, ...] slice (short tails are padded
-    by repeating rows, which compute redundantly: chunk_plans), plus a
-    per-dispatch overhead (host gather + upload + counter sync;
-    ~one group-block of useful work on the tunneled TPU, the
-    default).  Smaller chunks track the decaying active set with less
-    padding waste; larger chunks amortize the dispatch overhead —
-    exactly the trade named in ROADMAP item 1.
+    by repeating rows — pad_mask cond-skips their compute, but the
+    transfer is still paid), plus a per-dispatch overhead (host gather
+    + upload + counter sync; ~one group-block of useful work on the
+    tunneled TPU, the hand-set default).  Pass the MEASURED value from
+    :func:`calibrate_dispatch_overhead` when a pipeline has run — the
+    grouped pass does, recording the calibration in
+    ``sched_extra["chunk_overhead_units"]`` and the bench/SCALE
+    artifact extras.  Smaller chunks track the decaying active set
+    with less padding waste; larger chunks amortize the dispatch
+    overhead — exactly the trade named in ROADMAP item 1.
 
     Candidates are the pow2 ladder 1..g_exec (so the recommendation
     lands on a small set of compiled [chunk, ...] shape families); ties

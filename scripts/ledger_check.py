@@ -87,9 +87,14 @@ def grouped_sched_gate() -> int:
     """Quiet-group scheduler compile-family gate: a chunked grouped
     pass with the scheduler ON must introduce ZERO new compile families
     versus the always-dispatch path — compaction gathers group slices
-    for the SAME compiled [chunk, ...] program, so the second run below
+    for the SAME compiled [chunk, ...] program, so the later runs below
     (same process, jit caches warm from the scheduler-off run) may not
-    compile anything new under any ``groups.*`` entry point."""
+    compile anything new under any ``groups.*`` entry point.  The same
+    contract covers the device-resident quiet mask (PARMMG_DEVICE_MASK,
+    parallel/sched.py): the mask is ALWAYS an argument of the compiled
+    block programs, so a mask-on run vs a mask-off run in one process
+    must also add zero ``groups.*`` families — the ``lax.cond`` wrapper
+    may not mint new variants."""
     import jax.numpy as jnp
     from parmmg_tpu.core.mesh import make_mesh
     from parmmg_tpu.ops.analysis import analyze_mesh
@@ -99,8 +104,9 @@ def grouped_sched_gate() -> int:
                                                variants_by_prefix)
     from parmmg_tpu.utils.fixtures import cube_mesh
 
-    def run(sched: str):
+    def run(sched: str, mask: str = "1"):
         os.environ["PARMMG_GROUP_SCHED"] = sched
+        os.environ["PARMMG_DEVICE_MASK"] = mask
         vert, tet = cube_mesh(2)
         m = make_mesh(vert, tet, capP=4 * len(vert), capT=4 * len(tet))
         m = analyze_mesh(m).mesh
@@ -113,14 +119,17 @@ def grouped_sched_gate() -> int:
 
     # save/restore the operator's knob values (bench.py does the same)
     prev = {k: os.environ.get(k)
-            for k in ("PARMMG_GROUP_CHUNK", "PARMMG_GROUP_SCHED")}
+            for k in ("PARMMG_GROUP_CHUNK", "PARMMG_GROUP_SCHED",
+                      "PARMMG_DEVICE_MASK")}
     os.environ["PARMMG_GROUP_CHUNK"] = "1"
     try:
         reset_ledger()
-        run("0")
+        run("0", mask="0")            # legacy always-dispatch, no mask
         v0 = grp_variants()
-        run("1")
+        run("1", mask="0")            # compaction on, device mask off
         v1 = grp_variants()
+        run("1", mask="1")            # compaction + device mask
+        v2 = grp_variants()
     finally:
         for k, v in prev.items():
             if v is None:
@@ -134,6 +143,11 @@ def grouped_sched_gate() -> int:
         print("SCHEDULER COMPILE-FAMILY REGRESSIONS (scheduler on "
               f"added variants): {v0} -> {v1}", file=sys.stderr)
         return 1
+    if v2 != v1:
+        print("DEVICE-MASK COMPILE-FAMILY REGRESSIONS (mask-on run "
+              f"added variants vs mask-off): {v1} -> {v2}",
+              file=sys.stderr)
+        return 1
     bad = ledger_violations()
     if bad:
         print("\nLEDGER BUDGET VIOLATIONS (grouped scheduler):",
@@ -141,7 +155,8 @@ def grouped_sched_gate() -> int:
         for v in bad:
             print(f"  {v}", file=sys.stderr)
         return 1
-    print(f"grouped scheduler OK: zero new compile families ({v1})")
+    print(f"grouped scheduler OK: zero new compile families ({v2}; "
+          "scheduler AND device mask)")
     return 0
 
 

@@ -18,9 +18,16 @@ trace JSONL.  The same env knob arms a capture around outer pass
 ``PARMMG_PROFILE_PASS=start[:stop]`` of any grouped/distributed run
 (driver, bench, scale_big workers) — this script is just the smallest
 recipe that produces a timeline.
+
+``--json PATH`` additionally writes the captured phase->milliseconds
+map to PATH; ``bench.py`` embeds it into the artifact under
+``extra.profile_phases`` when ``BENCH_PROFILE_JSON`` points at it, so
+a checked-in BENCH round carries the one-pass phase profile and the
+next chip session can diff the SAME phase names on a real timeline.
 """
 from __future__ import annotations
 
+import json
 import os
 import sys
 import time
@@ -48,6 +55,9 @@ from parmmg_tpu.ops.swap import swap23_wave, swap32_wave
 from parmmg_tpu.utils.fixtures import cube_mesh, analytic_iso_metric
 
 
+PHASES_MS: dict[str, float] = {}    # label -> min ms (the --json payload)
+
+
 def timeit(label, fn, *args, reps=3, **kw):
     jfn = jax.jit(fn, **kw)
     out = jfn(*args)
@@ -61,12 +71,21 @@ def timeit(label, fn, *args, reps=3, **kw):
             out = jfn(*args)
             jax.block_until_ready(out)
             ts.append(time.perf_counter() - t0)
+    PHASES_MS[label] = round(min(ts) * 1e3, 3)
     print(f"  {label:28s} {min(ts)*1e3:9.2f} ms")
     return out
 
 
 def main():
-    n = int(sys.argv[1]) if len(sys.argv) > 1 else 16
+    argv = sys.argv[1:]
+    json_out = None
+    if "--json" in argv:
+        i = argv.index("--json")
+        if i + 1 >= len(argv):
+            sys.exit("usage: profile_adapt.py [n] [--json PATH]")
+        json_out = argv[i + 1]
+        argv = argv[:i] + argv[i + 2:]
+    n = int(argv[0]) if argv else 16
     vert, tet = cube_mesh(n)
     mesh = make_mesh(vert, tet, capP=3 * len(vert), capT=3 * len(tet))
     mesh = analyze_mesh(mesh).mesh
@@ -113,8 +132,17 @@ def main():
                 dt = time.perf_counter() - t0
         print(f"  adapt_cycle(do_swap={do_swap!s:5}) "
               f"{dt*1e3:9.2f} ms  counts={np.asarray(c)[:5]}")
+        PHASES_MS[f"adapt_cycle_swap{int(do_swap)}"] = round(dt * 1e3, 3)
 
     otrace.profile_pass_end(0)
+
+    if json_out:
+        with open(json_out, "w") as f:
+            json.dump({"n": n, "ntets": len(tet),
+                       "device": jax.devices()[0].platform,
+                       "phases_ms": PHASES_MS}, f, indent=1)
+        print(f"profile: phase timings written to {json_out}",
+              file=sys.stderr)
 
 
 if __name__ == "__main__":

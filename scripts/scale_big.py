@@ -130,13 +130,24 @@ def worker() -> None:
             stats.sched_extra.get("active_groups_per_block", []),
             np.int64),
         # chunk auto-tune (sched.recommend_group_chunk, logged by the
-        # grouped pass): adopted only under PARMMG_GROUP_CHUNK=auto
+        # grouped pass): adopted only under PARMMG_GROUP_CHUNK=auto;
+        # the overhead constant of its cost model is CALIBRATED from
+        # this pass's measured pipeline segment timings (ROADMAP 1b)
         "chunk_recommendation": np.asarray(
             stats.sched_extra.get("chunk_recommendation", [0])[-1],
             np.int64),
+        # NaN = this pass produced no calibration signal (unchunked or
+        # empty segments) — distinct from a measured zero overhead
+        "chunk_overhead": np.asarray(
+            stats.sched_extra.get("chunk_overhead_units", [np.nan])[-1],
+            np.float64),
         "group_dispatches": np.asarray(stats.group_dispatches, np.int64),
         "saved_dispatches": np.asarray(stats.group_dispatches_saved,
                                        np.int64),
+        # group-slot executions the device-resident quiet mask
+        # lax.cond-skipped (parallel/sched.py, PR 12)
+        "cond_skipped": np.asarray(
+            stats.sched_extra.get("cond_skipped_rows", 0), np.int64),
         "sched_timers": np.asarray(json.dumps(sched_timers)),
         "device": np.asarray(jax.default_backend()),
         # this worker's compile ledger rides back to the orchestrator
@@ -294,7 +305,9 @@ def main():
     sched_timers = {}
     group_disp = 0
     saved_disp = 0
+    cond_skipped = 0
     chunk_rec = 0
+    chunk_overhead = {}
     for it in range(it0, niter):
         nxt = f"{tmp}/state{it + 1}.npz"
         env = dict(os.environ)
@@ -354,6 +367,12 @@ def main():
             group_disp += int(z["group_dispatches"])
             saved_disp += int(z["saved_dispatches"])
             sched_timers[f"pass{it}"] = json.loads(str(z["sched_timers"]))
+        if "cond_skipped" in z.files:
+            cond_skipped += int(z["cond_skipped"])
+        if "chunk_overhead" in z.files and \
+                np.isfinite(float(z["chunk_overhead"])):
+            chunk_overhead[f"pass{it}"] = round(
+                float(z["chunk_overhead"]), 4)
         if "chunk_recommendation" in z.files:
             chunk_rec = int(z["chunk_recommendation"])
             print(f"scale: pass {it} recommends PARMMG_GROUP_CHUNK="
@@ -452,7 +471,12 @@ def main():
             "active_groups_per_block": active_traj,
             "group_dispatches": group_disp,
             "saved_dispatches": saved_disp,
+            # device-resident quiet mask (PR 12): lax.cond-skipped
+            # group-slot executions + the measured per-dispatch
+            # overhead calibration feeding the chunk auto-tune
+            "cond_skipped": cond_skipped,
             "chunk_recommendation": chunk_rec,
+            "chunk_overhead_calibration": chunk_overhead,
             "sched_pipeline_s": sched_timers,
             # per-pass worker compile ledgers + the orchestrator's own
             # (compile governor): steady-state passes should show ~zero

@@ -3,10 +3,14 @@
 The scheduler skips chunked group-block dispatches for groups that a
 swap-inclusive block proved quiet — exact because frozen MG_PARBDY
 seams + deterministic waves make a zero-op group state a fixed point
-(sched module docstring).  Fast tests pin the host-side state machine
-(no XLA compiles — tier-1 budget); the slow tests pin the end-to-end
-contracts: bit-for-bit parity vs always-dispatch, the quiet fixed
-point, and the strictly-fewer-dispatches acceptance gate.
+(sched module docstring).  PR 12 pushes the same proof into the
+compiled programs as a device-resident active mask (lax.cond group
+bodies, PARMMG_DEVICE_MASK): fast tests pin the mask plumbing
+(block_mask levels, pad_mask, cond_skipped accounting, the measured
+chunk-overhead calibration) host-side; the slow tests pin the
+end-to-end contracts: bit-for-bit parity vs always-dispatch AND vs
+mask-off on the unchunked layout, the quiet fixed point, and the
+strictly-fewer-dispatches acceptance gate.
 
 The packed-halo hysteresis satellite (comms.packed_halo_rows ``state``)
 is pinned here too: the dense/packed layout decision must be sticky
@@ -114,6 +118,85 @@ def test_chunk_plans_pads_tail_with_repeat():
 
 
 # ---------------------------------------------------------------------------
+# device-resident quiet masks (tier-1: host-side plumbing only)
+# ---------------------------------------------------------------------------
+def test_pad_mask_masks_padded_tail_rows(monkeypatch):
+    from parmmg_tpu.parallel.sched import pad_mask
+    assert list(pad_mask(4, 2)) == [True, True, False, False]
+    assert list(pad_mask(3, 3)) == [True, True, True]
+    # PARMMG_DEVICE_MASK=0: all-true — the disabled path computes
+    # exactly what the pre-mask code did (pad rows discarded later)
+    monkeypatch.setenv("PARMMG_DEVICE_MASK", "0")
+    assert list(pad_mask(4, 1)) == [True] * 4
+    # PARMMG_GROUP_SCHED=0 is the FULL legacy escape hatch: it forces
+    # all-true masks too, even with the mask knob on
+    monkeypatch.delenv("PARMMG_DEVICE_MASK")
+    monkeypatch.setenv("PARMMG_GROUP_SCHED", "0")
+    assert list(pad_mask(4, 1)) == [True] * 4
+
+
+def test_block_mask_levels_and_knob(monkeypatch):
+    """Unchunked dispatches: the mask is the only skip mechanism —
+    level >= LEVEL_PRE slots masked under prescreen-ON blocks, only
+    LEVEL_FULL slots under prescreen-OFF blocks; pads born masked;
+    cond_skipped accounts every masked slot."""
+    s = QuietGroupScheduler(ngroups=3, g_exec=4, chunk=0, enabled=True)
+    s.level[1] = LEVEL_PRE
+    s.level[2] = LEVEL_FULL
+    m_pre = s.block_mask(pres_all_on=True)
+    assert list(m_pre) == [True, False, False, False]   # pad 3 masked
+    m_full = s.block_mask(pres_all_on=False)
+    # a pres-OFF block re-runs LEVEL_PRE groups (exact split veto)
+    assert list(m_full) == [True, True, False, False]
+    assert s.cond_skipped == 3 + 2
+    # scheduler disabled: masks all-true, nothing accounted
+    s2 = QuietGroupScheduler(3, 4, 0, enabled=False)
+    s2.level[1] = LEVEL_FULL
+    assert list(s2.block_mask(True)) == [True] * 4
+    assert s2.cond_skipped == 0
+    # PARMMG_DEVICE_MASK=0 forces all-true even with the scheduler on
+    monkeypatch.setenv("PARMMG_DEVICE_MASK", "0")
+    s3 = QuietGroupScheduler(3, 4, 0, enabled=True)
+    s3.level[1] = LEVEL_FULL
+    assert list(s3.block_mask(True)) == [True] * 4
+    assert s3.cond_skipped == 0
+
+
+def test_note_plan_pads_accounts_masked_tail(monkeypatch):
+    s = QuietGroupScheduler(5, 6, 2, enabled=True)
+    plans = chunk_plans(np.array([0, 2, 4]), 2)   # tail padded 1 row
+    s.note_plan_pads(plans)
+    assert s.cond_skipped == 1
+    monkeypatch.setenv("PARMMG_DEVICE_MASK", "0")
+    s.note_plan_pads(plans)                        # disabled: no-op
+    assert s.cond_skipped == 1
+
+
+def test_calibrate_dispatch_overhead():
+    """ROADMAP 1b host-side validation: the cost model's overhead
+    constant is derived from the measured pipeline segments — per-
+    dispatch (upload+download+writeback) over per-GROUP compute."""
+    from parmmg_tpu.parallel.sched import calibrate_dispatch_overhead
+    acc = {"upload": 2.0, "download": 1.0, "writeback": 1.0,
+           "compute": 8.0}
+    cnt = {"upload": 4, "compute": 4, "download": 4, "writeback": 4}
+    # per dispatch: overhead (2+1+1)/4 = 1.0 s; compute 8/4/chunk=2
+    # = 1.0 s/group -> 1.0 group-units
+    assert calibrate_dispatch_overhead(acc, cnt, 2) == 1.0
+    # bigger chunk -> cheaper per-group compute -> higher overhead
+    assert calibrate_dispatch_overhead(acc, cnt, 4) == 2.0
+    # no signal cases keep the hand-set default (None)
+    assert calibrate_dispatch_overhead({}, {}, 2) is None
+    assert calibrate_dispatch_overhead(acc, cnt, 0) is None
+    assert calibrate_dispatch_overhead(
+        {"compute": 0.0, "upload": 1.0}, {"compute": 3}, 2) is None
+    # the calibration feeds recommend_group_chunk directly
+    from parmmg_tpu.parallel.sched import recommend_group_chunk
+    assert recommend_group_chunk([8, 8], 8, dispatch_overhead=2.0) in \
+        (2, 4, 8, 0)
+
+
+# ---------------------------------------------------------------------------
 # packed-halo hysteresis (comms satellite; tier-1: host numpy)
 # ---------------------------------------------------------------------------
 def _nbr_table(n_entries, G=4):
@@ -216,6 +299,57 @@ def test_sched_parity_bit_for_bit(monkeypatch):
 
 # slow: multi-minute XLA compile on the tier-1 CPU box (tier-2 covers it)
 @pytest.mark.slow
+def test_device_mask_parity_unchunked(monkeypatch):
+    """Device-mask bit-for-bit parity (PR 12): UNCHUNKED dispatches
+    (PARMMG_GROUP_CHUNK=0) are where the lax.cond mask is the ONLY skip
+    mechanism — host compaction cannot change the dispatch shape.
+    Mask-on (scheduler levels -> cond identity for quiet slots) must
+    merge byte-identical to sched-off (every slot computes), polish on
+    (the unchunked polish loop is shared, so the cycle loop is the
+    masked path under test).  The x-slab calm fixture guarantees quiet
+    groups arise BEFORE convergence, so the mask demonstrably engages
+    (cond_skipped > 0) rather than passing vacuously."""
+    from parmmg_tpu.core.mesh import MESH_FIELDS, make_mesh
+    from parmmg_tpu.ops.adapt import AdaptStats
+    from parmmg_tpu.ops.analysis import analyze_mesh
+    from parmmg_tpu.parallel.groups import grouped_adapt_pass
+    from parmmg_tpu.utils.fixtures import cube_mesh
+
+    n = 3
+    vert, tet = cube_mesh(n)
+    cent = vert[tet].mean(axis=1)
+    part = np.minimum((cent[:, 0] * n).astype(np.int64), n - 1)
+    h = np.where(vert[:, 0] < 1e-9, 0.15, 1.3 / n)
+    monkeypatch.setenv("PARMMG_GROUP_CHUNK", "0")
+
+    def run(sched, mask):
+        monkeypatch.setenv("PARMMG_GROUP_SCHED", sched)
+        monkeypatch.setenv("PARMMG_DEVICE_MASK", mask)
+        m = make_mesh(vert, tet, capP=4 * len(vert), capT=4 * len(tet))
+        m = analyze_mesh(m).mesh
+        met = jnp.zeros(m.capP, m.vert.dtype).at[: len(h)].set(
+            jnp.asarray(h, m.vert.dtype)).at[len(h):].set(1.0)
+        st = AdaptStats()
+        out, met2, p = grouped_adapt_pass(
+            m, met, n, cycles=5, part=part, stats=st, nomove=True,
+            noswap=True, polish=True)
+        return out, np.asarray(met2), np.asarray(p), st
+
+    ref, kref, pref, st0 = run("0", "0")
+    chk, kchk, pchk, st1 = run("1", "1")
+    for f in MESH_FIELDS:
+        a = np.asarray(getattr(ref, f))
+        b = np.asarray(getattr(chk, f))
+        assert (a == b).all(), f"merged field {f} differs mask on/off"
+    assert (kref == kchk).all(), "merged metric differs mask on/off"
+    assert (pref == pchk).all()
+    # the mask demonstrably skipped group-slot executions on device
+    assert st1.sched_extra.get("cond_skipped_rows", 0) > 0
+    assert st0.sched_extra.get("cond_skipped_rows", 0) == 0
+
+
+# slow: multi-minute XLA compile on the tier-1 CPU box (tier-2 covers it)
+@pytest.mark.slow
 def test_sched_saves_dispatches_and_quiet_fixed_point(monkeypatch):
     """Acceptance gate: on a run where some groups converge early the
     scheduler executes strictly fewer group-block dispatches than
@@ -272,9 +406,10 @@ def test_sched_saves_dispatches_and_quiet_fixed_point(monkeypatch):
     calm = jax.tree.map(lambda a: a[1:2], stacked)
     kcalm = met_s[1:2]
     step = _group_block((True,), (False,), True, False, None)
-    m1, k1, c1 = step(calm, kcalm, jnp.asarray(0, jnp.int32))
+    on = jnp.ones(1, bool)
+    m1, k1, c1 = step(calm, kcalm, jnp.asarray(0, jnp.int32), on)
     assert int(np.asarray(c1)[..., :5].sum()) == 0, np.asarray(c1)
-    m2_, k2, c2 = step(m1, k1, jnp.asarray(1, jnp.int32))
+    m2_, k2, c2 = step(m1, k1, jnp.asarray(1, jnp.int32), on)
     assert int(np.asarray(c2)[..., :5].sum()) == 0
     for f in MESH_FIELDS:
         a, b = np.asarray(getattr(m1, f)), np.asarray(getattr(m2_, f))
