@@ -97,6 +97,29 @@ KNOBS: dict[str, Knob] = {
         "flag", "",
         "1 = skip the device analysis-refresh path and always use the "
         "host fallback"),
+    "PARMMG_MH_CACHE_DIR": Knob(
+        "path", "",
+        "shared persistent compile-cache dir for multi-host pod "
+        "workers (parallel/multihost.init_multihost): worker 0 warms, "
+        "workers N+1 deserialize instead of recompiling"),
+    "PARMMG_MH_COLLECTIVES": Knob(
+        "str", "gloo",
+        "cross-process CPU collectives implementation for the dev pod "
+        "(gloo | mpi | none); ignored on real chip interconnects"),
+    "PARMMG_MH_HANDOFF": Knob(
+        "flag", "",
+        "1 = host-to-host group handoff: rebalance logical shards "
+        "across devices/processes between iterations (parallel/pod.py;"
+        " off by default — reordering arrivals breaks bit-parity with "
+        "the no-handoff run)"),
+    "PARMMG_MH_IMBALANCE": Knob(
+        "float", "0.25",
+        "device load skew (max/mean - 1) above which the group "
+        "handoff re-plans placement"),
+    "PARMMG_MH_STRICT": Knob(
+        "flag", "",
+        "1 = raise on any hot-path process_allgather instead of only "
+        "metering it (mh.hot_allgather_bytes tripwire)"),
     "PARMMG_NARROW_DIV": Knob(
         "int", "",
         "narrow-row budget divisor override (ops/active.py); empty = "

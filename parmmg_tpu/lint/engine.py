@@ -45,6 +45,8 @@ RULE_TITLES = {
     "R4": "knob-registry (PARMMG_* reads match api/knobs.py + README)",
     "R5": "jaxcompat (version-shimmed jax symbols only via the shim)",
     "R6": "name-schemes (static dotted metric/trace/fault names)",
+    "R7": "mh-allgather (no pull_host/process_allgather on the pod "
+          "hot path; route band tables through pod.gather_band)",
     "SUPP": "suppression hygiene (reason required)",
 }
 

@@ -94,12 +94,12 @@ def _called_names(fn_node) -> set:
     return out
 
 
-def _reachable(idx) -> dict:
-    """{id(fn_node): (SourceFile, qualname, node)} reachable from ROOTS
-    via simple-name edges."""
+def _reachable(idx, roots=ROOTS) -> dict:
+    """{id(fn_node): (SourceFile, qualname, node)} reachable from
+    ``roots`` via simple-name edges (shared by R2 and R7)."""
     seen: dict[int, tuple] = {}
     work = []
-    for r in ROOTS:
+    for r in roots:
         for ent in idx.get(r, ()):
             if id(ent[2]) not in seen:
                 seen[id(ent[2])] = ent
@@ -114,32 +114,81 @@ def _reachable(idx) -> dict:
     return seen
 
 
+def _direct_body(qn: str, fn_node):
+    """(full qualname, nested-node id set to skip, suppression anchor
+    lines) for scanning a function's DIRECT body: nested defs are their
+    own graph nodes, and a def-line (or first-decorator-line)
+    suppression exempts the whole function — the shared R2/R7
+    per-function scaffolding."""
+    qn_full = f"{qn}.{fn_node.name}" if qn != "<module>" \
+        else fn_node.name
+    skip = set()
+    for nf in ast.walk(fn_node):
+        if isinstance(nf, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and nf is not fn_node:
+            for x in ast.walk(nf):
+                skip.add(id(x))
+    def_lines = (fn_node.lineno,) + (
+        (fn_node.decorator_list[0].lineno,)
+        if fn_node.decorator_list else ())
+    return qn_full, skip, def_lines
+
+
+#: R7 reachability roots — R2's hot-path roots plus the band-migration
+#: pipeline and the multi-iteration distributed driver (the pod hot
+#: path, parallel/pod.py): these are the functions whose steady state
+#: must never replicate state through the pull_host escape hatch
+R7_ROOTS = ROOTS + (
+    "distributed_adapt_multi",
+    "band_migrate_iteration",
+    "band_weld",
+    "repair_flood_labels",
+    "graph_repartition_labels_band",
+)
+
+#: the escape-hatch primitives R7 flags (callee simple/dotted names)
+_R7_CALLS = ("pull_host", "_pull", "process_allgather")
+
+
+@rule("R7")
+def check_r7(ctx) -> list:
+    """The runtime tripwire's static mirror: ``pull_host`` increments
+    ``mh.hot_allgather_bytes`` when reached inside a hot_path section
+    (gate-asserted zero); this rule flags the CALL SITES so a stray
+    allgather on the pod hot path fails in seconds at lint time, before
+    any 2-process run.  Legitimate escape hatches (budget-overflow
+    fallbacks, checkpoint IO under cold_io, the final-output gather)
+    carry reasoned suppressions."""
+    idx = _functions(ctx)
+    out = []
+    for sf, qn, fn_node in _reachable(idx, R7_ROOTS).values():
+        qn_full, skip, def_lines = _direct_body(qn, fn_node)
+        for n in ast.walk(fn_node):
+            if id(n) in skip or not isinstance(n, ast.Call):
+                continue
+            d = dotted(n.func)
+            leaf = d.rsplit(".", 1)[-1] if d else ""
+            if leaf not in _R7_CALLS:
+                continue
+            out.append(Violation(
+                "R7", sf.rel, n.lineno, qn_full, leaf,
+                f"escape-hatch allgather {leaf}() reachable from the "
+                f"pod hot path (roots: {', '.join(R7_ROOTS)}) — band "
+                "tables ride pod.gather_band",
+                anchor_lines=def_lines))
+    return out
+
+
 @rule("R2")
 def check_r2(ctx) -> list:
     idx = _functions(ctx)
     reach = _reachable(idx)
     out = []
     for sf, qn, fn_node in reach.values():
-        qn_full = f"{qn}.{fn_node.name}" if qn != "<module>" \
-            else fn_node.name
-        # direct body only: nested defs are separate graph nodes, so a
-        # pull inside `dispatch` is attributed to `dispatch`, not to
-        # every enclosing scope
-        own_nested = [x for x in ast.walk(fn_node)
-                      if isinstance(x, (ast.FunctionDef,
-                                        ast.AsyncFunctionDef))
-                      and x is not fn_node]
-        skip = set()
-        for nf in own_nested:
-            for x in ast.walk(nf):
-                skip.add(id(x))
-        # suppression anchors: the def line, and for decorated
-        # functions the FIRST decorator's line — a standalone
-        # '# lint: ok(R2)' comment above the decorator resolves to
-        # that line (next non-comment), not to the def
-        def_lines = (fn_node.lineno,) + (
-            (fn_node.decorator_list[0].lineno,)
-            if fn_node.decorator_list else ())
+        # direct body only (nested defs are separate graph nodes); the
+        # def/decorator lines anchor whole-function fallback
+        # suppressions — shared scaffolding, _direct_body
+        qn_full, skip, def_lines = _direct_body(qn, fn_node)
         for n in ast.walk(fn_node):
             if id(n) in skip or not isinstance(n, ast.Call):
                 continue

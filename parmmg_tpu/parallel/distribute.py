@@ -324,6 +324,44 @@ def grow_shards(shards: Mesh, mets, new_capP: int, new_capT: int):
     return out, padP(mets)
 
 
+# compiled leading-axis permutation programs keyed by (device ids, leaf
+# shapes) — the host-to-host group handoff (parallel/pod.py): one
+# x[perm] gather per leaf inside a single jit whose out_shardings keep
+# the 'shard' leading axis, so XLA realizes the row moves as
+# cross-device (and thereby cross-process) transfers of whole groups
+_PERMUTE_CACHE: dict = {}
+
+
+def permute_shards(shards: Mesh, mets, glo_d, perm, dmesh):
+    """Reorder the logical-shard leading axis: new row ``i`` = old row
+    ``perm[i]`` (a bijection, G rows per device preserved by the
+    caller's plan).  Row CONTENTS — slot ids, and thereby the comm
+    tables' local indices — are untouched: the handoff moves whole
+    groups, the frozen-interface contract survives by construction.
+    Returns (shards', mets', glo_d' | None)."""
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from ..utils.compilecache import governed
+
+    leaves = (shards, mets) if glo_d is None else (shards, mets, glo_d)
+    flat = jax.tree.leaves(leaves)
+    # lint: ok(R2) — device-id metadata + abstract leaf shapes (cache
+    # key construction), no device sync
+    key = (tuple(d.id for d in np.asarray(dmesh.devices).flat),
+           tuple((tuple(x.shape), str(x.dtype)) for x in flat))
+    fn = _PERMUTE_CACHE.get(key)
+    if fn is None:
+        sh = NamedSharding(dmesh, P("shard"))
+        fn = governed("mh.group_handoff", budget=8)(
+            jax.jit(lambda xs, p: jax.tree.map(lambda x: x[p], xs),
+                    out_shardings=sh))
+        _PERMUTE_CACHE[key] = fn
+    out = fn(leaves, jnp.asarray(np.asarray(perm), jnp.int32))
+    if glo_d is None:
+        return out[0], out[1], None
+    return out
+
+
 def merge_shards(shards: Mesh, mets=None, return_part: bool = False):
     """Merge stacked shard Meshes back into one host Mesh (+ metric).
 

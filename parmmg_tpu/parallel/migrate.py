@@ -225,6 +225,36 @@ def extend_global_ids_from_vmask(glo: list[np.ndarray],
     return top
 
 
+def apply_fresh_ids(glo: list[np.ndarray], rows: np.ndarray,
+                    gids: np.ndarray) -> None:
+    """Write device-assigned fresh ids into the host numbering mirror:
+    ``rows``/``gids`` are [S, K] compacted tables (-1 pads) from
+    ``migrate_dev.extend_ids_device`` / ``device_migrate`` arrivals,
+    replicated to every process through ``pod.gather_band`` — the
+    band-sized mirror sync the old full-vmask allgather performed at
+    O(mesh) width."""
+    for s, g in enumerate(glo):
+        m = rows[s] >= 0
+        g[rows[s][m]] = gids[s][m].astype(np.int64)
+
+
+def kill_glo_rows(glo: list[np.ndarray], rows: np.ndarray,
+                  cnt: np.ndarray) -> None:
+    """Drop the ids of newly-dead vertex rows from the host mirror:
+    ``rows`` [S, K] compacted (pad >= capP or -1), ``cnt`` [S] live
+    counts — the per-iteration DELTA of the liveness mask (probe:
+    ``migrate_dev.dead_glo_rows`` / ``device_migrate`` info), which is
+    band-sized where the mask itself is O(mesh).  Exactness: glo >= 0
+    only at live id-carrying rows (the mirror invariant every producer
+    maintains — adapt deaths here, migration departures via
+    device_migrate's probe, welds explicitly in band_weld), so killing
+    the delta keeps the invariant without ever shipping the mask."""
+    for s, g in enumerate(glo):
+        r = rows[s][: int(cnt[s])]
+        r = r[(r >= 0) & (r < len(g))]
+        g[r] = -1
+
+
 def extend_global_ids(glo: list[np.ndarray], views: ShardViews, top: int):
     return extend_global_ids_from_vmask(glo, views.vmask, top)
 

@@ -270,14 +270,28 @@ def device_migrate(stacked: Mesh, met_s, glo_d, labels, depth,
         new_row_sorted.astype(jnp.int32), mode="drop")
     arr_gids = jnp.full((S, KV), -1, jnp.int32).at[sidx, alloc_tgt].set(
         msort.astype(jnp.int32), mode="drop")
+    # newly-DEAD vertex rows (id-carrying before, unreferenced after the
+    # departures), compacted: the band-sized liveness DELTA that lets
+    # the host glo mirror sync without an O(mesh) vmask allgather
+    # (migrate.kill_glo_rows; dying rows are vertices of departed tets,
+    # so the KV budget that bounds arrivals bounds them too — overflow
+    # joins the ok fallback like every other budget)
+    newly_dead = (glo_d >= 0) & ~ref
+    n_dead = jnp.sum(newly_dead, axis=1)
+    ok = ok & jnp.all(n_dead <= KV)
+    dead_rows = jax.vmap(lambda m: jnp.nonzero(m, size=KV,
+                                               fill_value=capP)[0])(
+        newly_dead).astype(jnp.int32)
     info = dict(ok=ok, nmoved=nmoved, arr_rows=arr_rows,
                 arr_gids=arr_gids, dep_slots=midx,
                 arr_slots=arr_slot, labels=labels,
+                dead_rows=dead_rows, dead_cnt=n_dead.astype(jnp.int32),
                 # per-condition diagnostics (which budget blew)
                 ok_parts=jnp.stack([
                     jnp.all(nmove <= KB), jnp.all(seg_cnt <= KB),
                     jnp.all(n_new <= KV), jnp.all(n_new <= nfree),
-                    jnp.all(seg_cnt <= nfree_t)]))
+                    jnp.all(seg_cnt <= nfree_t),
+                    jnp.all(n_dead <= KV)]))
     return out, met2, glo2, info
 
 
@@ -535,6 +549,26 @@ def extend_ids_device(glo_d, vmask, top, KN: int):
             jnp.where(valid, gids, -1), ok)
 
 
+@governed("migrate_dev.dead_rows", budget=4)
+@partial(jax.jit, static_argnames=("KD",))
+def dead_glo_rows(glo_d, vmask, KD: int):
+    """Compacted newly-dead vertex rows: live-id rows of the numbering
+    whose liveness mask has dropped (adapt-cycle collapses since the
+    last mirror sync).  The band-sized DELTA replacing the hot-loop
+    O(mesh) vmask allgather of the pre-pod multi-host path — the host
+    mirror kills exactly these rows (migrate.kill_glo_rows).
+
+    Returns (rows [S, KD] int32 (pad capP), cnt [S], ok); ok False =
+    budget overflow, caller takes the metered pull_host escape hatch."""
+    S, capP = glo_d.shape
+    dead = (glo_d >= 0) & ~vmask
+    cnt = jnp.sum(dead, axis=1, dtype=jnp.int32)
+    rows = jax.vmap(lambda m: jnp.nonzero(m, size=KD,
+                                          fill_value=capP)[0])(
+        dead).astype(jnp.int32)
+    return rows, cnt, jnp.all(cnt <= KD)
+
+
 def session_ids_fit(top: int, n_shards: int, KN: int) -> bool:
     """Whether this iteration's fresh-id block provably fits the int32
     device numbering (the module-docstring contract): extend_ids_device
@@ -592,7 +626,10 @@ def band_migrate_iteration(stacked: Mesh, met_s, glo_d,
     if not ok:
         if verbose >= 1:
             names = ("nmove<=KB", "arrivals<=KB", "new_v<=KV",
-                     "new_v<=free_v", "arrivals<=free_t")
+                     "new_v<=free_v", "arrivals<=free_t", "dead<=KV")
+            # lint: ok(R7) — fallback diagnostic off the steady path
+            # (the iteration is being abandoned to the full-view
+            # oracle); tiny [6] bool vector
             parts = _pull(info["ok_parts"])
             bad = [n for n, p in zip(names, parts) if not p]
             otrace.log(1, f"  band migrate overflow: {bad}",
@@ -607,10 +644,9 @@ def band_migrate_iteration(stacked: Mesh, met_s, glo_d,
     if not bool(okf):
         return None
 
-    # ---- cross-shard face match -----------------------------------------
-    keys = _pull(keys)
-    slots = _pull(slots)
-    cnt = _pull(cnt)
+    # ---- cross-shard face match (band exchange, pod.gather_band) --------
+    from .pod import gather_band
+    keys, slots, cnt = gather_band(keys, slots, cnt, what="faces")
     ks, sl, sh = [], [], []
     for s in range(S):
         n = int(cnt[s])
@@ -631,16 +667,19 @@ def band_migrate_iteration(stacked: Mesh, met_s, glo_d,
         # interfaces from whole views, which stays consistent)
         return None
 
-    # ---- host glo mirror sync (arrivals + liveness) ---------------------
+    # ---- host glo mirror sync (arrivals + newly-dead delta) -------------
     # (after the pairing guard: a None return above must leave the host
-    # glo mirror untouched for the full-view fallback)
-    arr_rows = _pull(info["arr_rows"])
-    arr_gids = _pull(info["arr_gids"])
-    vmask_h = _pull(stacked2.vmask)
-    for s in range(S):
-        m = arr_rows[s] >= 0
-        glo[s][arr_rows[s][m]] = arr_gids[s][m].astype(np.int64)
-        glo[s][~vmask_h[s]] = -1
+    # glo mirror untouched for the full-view fallback.)  One band
+    # exchange replaces the old O(mesh) vmask allgather: arrivals write
+    # their device-assigned rows, the compacted dead delta drops its
+    # ids — the mirror invariant (glo >= 0 iff live id-carrying row)
+    # makes the delta exact (migrate.kill_glo_rows)
+    from .migrate import apply_fresh_ids, kill_glo_rows
+    arr_rows, arr_gids, dead_rows, dead_cnt, arr_slots = gather_band(
+        info["arr_rows"], info["arr_gids"], info["dead_rows"],
+        info["dead_cnt"], info["arr_slots"], what="migrate_glo")
+    apply_fresh_ids(glo, arr_rows, arr_gids)
+    kill_glo_rows(glo, dead_rows, dead_cnt)
 
     pair = np.concatenate([eq, [False]])
     iA = np.where(pair)[0]
@@ -672,8 +711,9 @@ def band_migrate_iteration(stacked: Mesh, met_s, glo_d,
         loc = np.clip(lo, 0, len(gs) - 1)
         hit = (gs[loc] == cands) & (cands >= 0)
         row = np.where(hit, o[loc], -1)
+        # liveness IS the id hit: the mirror invariant (synced above)
+        # guarantees glo >= 0 only at live rows — no mask consult
         live = hit & (row >= 0)
-        live[live] = vmask_h[s][row[live]]
         rows_per.append(np.where(live, row, -1))
         live_per.append(live)
     nliv = np.sum(live_per, axis=0)
@@ -719,7 +759,7 @@ def band_migrate_iteration(stacked: Mesh, met_s, glo_d,
                   f"{int(shared.sum())} shared vertices "
                   "(device path)", verbose=verbose)
     return (stacked2, met2, glo_d2, comms, shared_now, nmoved,
-            _pull(info["arr_slots"]))
+            arr_slots)
 
 
 def band_weld(stacked: Mesh, met_s, glo_d, glo: list[np.ndarray],
@@ -738,26 +778,36 @@ def band_weld(stacked: Mesh, met_s, glo_d, glo: list[np.ndarray],
     KW = max(512, capT // 2)
     KWp = max(512, capP // 2)
     seed = jnp.asarray(arr_slots)
-    trow, vrow, tcnt, vcnt, v_open, ok = band_region_probe(
-        stacked, glo_d, seed, KW=KW, KWp=KWp)
-    if not bool(ok):
-        return stacked, glo_d, -1   # caller may fall back
-    trow = _pull(trow)
-    vrow = _pull(vrow)
-    tcnt = _pull(tcnt)
-    vcnt = _pull(vcnt)
-    v_open = _pull(v_open)
-    # one consolidated gather pull of the region rows
+    while True:
+        trow, vrow, tcnt, vcnt, v_open, ok = band_region_probe(
+            stacked, glo_d, seed, KW=KW, KWp=KWp)
+        if bool(ok):
+            break
+        if KW >= capT and KWp >= capP:
+            # cannot happen (the region is at most the live mesh, and
+            # the full-width probe holds it) — kept as the caller's
+            # documented full-weld fallback signal
+            return stacked, glo_d, -1
+        # the probe budget is a COMPACTION table, not a capacity: a big
+        # arrival neighborhood just needs a wider table.  Double toward
+        # the full width (one extra governed variant at most) instead
+        # of abandoning the band path — the full-view weld fallback is
+        # single-controller and would kill a multi-process run.
+        KW = min(capT, KW * 2)
+        KWp = min(capP, KWp * 2)
+    from .pod import gather_band
+    trow, vrow, tcnt, vcnt, v_open = gather_band(
+        trow, vrow, tcnt, vcnt, v_open, what="weld_probe")
+    # one consolidated region gather (device compaction) + ONE band
+    # exchange of the resulting tables
     sidx = jnp.arange(S)[:, None]
     tr_c = jnp.clip(jnp.asarray(trow), 0, capT - 1)
     vr_c = jnp.clip(jnp.asarray(vrow), 0, capP - 1)
-    tet_r = _pull(stacked.tet[sidx, tr_c])
-    tref_r = _pull(stacked.tref[sidx, tr_c])
-    ftag_r = _pull(stacked.ftag[sidx, tr_c])
-    etag_r = _pull(stacked.etag[sidx, tr_c])
-    vert_r = _pull(stacked.vert[sidx, vr_c])
-    vtag_r = _pull(stacked.vtag[sidx, vr_c])
-    met_r = _pull(met_s[sidx, vr_c])
+    tet_r, tref_r, ftag_r, etag_r, vert_r, vtag_r, met_r = gather_band(
+        stacked.tet[sidx, tr_c], stacked.tref[sidx, tr_c],
+        stacked.ftag[sidx, tr_c], stacked.etag[sidx, tr_c],
+        stacked.vert[sidx, vr_c], stacked.vtag[sidx, vr_c],
+        met_s[sidx, vr_c], what="weld_region")
     tet_d = stacked.tet
     tmask_d = stacked.tmask
     vmask_d = stacked.vmask
@@ -934,17 +984,19 @@ def repair_flood_labels(stacked: Mesh, labels_d, depth_d, n_shards: int,
     Returns (labels_d, nfixed).  Reference semantics:
     moveinterfaces_pmmg.c:475-626 (fix_contiguity merge into a neighbor
     color) and :627-720 (check_reachability revert)."""
-    cnts = _pull(flood_band_counts(stacked, labels_d, n_shards))
+    from .pod import gather_band
+    cnts = gather_band(flood_band_counts(stacked, labels_d, n_shards),
+                       what="flood_counts")
     if int(cnts.max()) == 0:
         return labels_d, 0
     capT = stacked.tet.shape[1]
     KB = bucket(int(cnts.max()), floor=1024, cap=capT)
-    # pull_host, not device_get: on a multi-process runtime the probe
-    # outputs are 'shard'-sharded global arrays (every process computes
-    # the identical host repair from the allgathered tables)
-    cnt, rows, lab, dep, rtet, out_touch = (
-        _pull(x) for x in
-        flood_probe(stacked, labels_d, depth_d, n_shards, KB))
+    # band exchange, not a per-leaf allgather: the probe outputs are
+    # 'shard'-sharded compacted tables and every process computes the
+    # identical host repair from the replicated copies
+    cnt, rows, lab, dep, rtet, out_touch = gather_band(
+        *flood_probe(stacked, labels_d, depth_d, n_shards, KB),
+        what="flood_probe")
     new_lab = np.full((n_shards, KB), -1, np.int32)
     nfixed = 0
     for s in range(n_shards):
@@ -1098,10 +1150,15 @@ def graph_repartition_labels_band(stacked: Mesh, comms, n_shards: int,
         fi2 = np.full((fi.shape[0], Kn, If), -1, fi.dtype)
         fi2[:, :fi.shape[1], :fi.shape[2]] = fi
         fi = fi2
-    # pull_host, not device_get: multi-process-safe (every process
-    # allgathers the same O(S*G^2 + interface) tables)
-    clus, nlive, cw, pcnt, cif = (
-        _pull(x) for x in graph_probe(stacked, jnp.asarray(fi), S, G))
+    # band exchange (pod.gather_band): every process receives the same
+    # O(S*G^2 + interface) tables through one compiled collective.
+    # clus/nlive stay DEVICE-resident: the host graph build never reads
+    # them (clus feeds _labels_from_parts on device) — the pre-pod path
+    # allgathered the O(mesh) cluster map just to re-upload it
+    from .pod import gather_band
+    clus, nlive, cw, pcnt, cif = graph_probe(stacked, jnp.asarray(fi),
+                                             S, G)
+    cw, pcnt, cif = gather_band(cw, pcnt, cif, what="graph")
     nclu = S * G
     pi, pj, w = [], [], []
     for s in range(S):
@@ -1146,5 +1203,5 @@ def graph_repartition_labels_band(stacked: Mesh, comms, n_shards: int,
     nmv = int((new_part != init).sum())
     otrace.log(2, f"  graph band labels: {nmv}/{nclu} clusters "
                   "reassigned", verbose=verbose)
-    return _labels_from_parts(jnp.asarray(clus), stacked.tmask,
+    return _labels_from_parts(clus, stacked.tmask,
                               jnp.asarray(new_part), S)

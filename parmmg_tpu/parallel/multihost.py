@@ -6,32 +6,40 @@ JAX-native equivalent is ``jax.distributed.initialize``: each host
 process owns its local TPU devices, ``jax.devices()`` becomes the GLOBAL
 device list, and the same ``shard_map`` programs of parallel/dist.py run
 unchanged — XLA lowers the 'shard' axis collectives onto ICI within a
-pod slice and DCN across slices.
+pod slice and DCN across slices (gloo on the CPU dev backend, knob
+PARMMG_MH_COLLECTIVES).
 
-What runs multi-host today:
-- the SPMD adapt blocks (`dist_adapt_block`), quality reductions
-  (`dist_quality`) and the on-device interface echo — their inputs are
-  built with :func:`shard_stacked_global`, which feeds each process only
-  its addressable shards (``jax.make_array_from_single_device_arrays``);
-- every process executes the identical host driver (single-program
-  multiple-data at the Python level too — the reference's "all ranks
-  agree via Allreduce" idiom maps to every process computing the same
-  host decisions from the same replicated scalars).
+What runs multi-host (the pod runtime, parallel/pod.py):
+- the SPMD adapt blocks, quality reductions, the on-device interface
+  echo and the whole band-migration pipeline — device arrays are
+  global ('shard'-sharded via :func:`shard_stacked_global`);
+- the band-path host stages: every process executes the identical host
+  driver (the reference's "all ranks agree via Allreduce" idiom) on
+  compacted band tables replicated through ``pod.gather_band`` — ONE
+  cached shard_map collective per table family, never a per-leaf
+  ``process_allgather``;
+- the persistent compile cache is SHARED across workers
+  (PARMMG_MH_CACHE_DIR): a warmed cache means worker N+1 deserializes
+  executables instead of re-paying the multi-minute SPMD compiles —
+  the scripts/multihost_run.py phase structure.
 
-What stays single-host: the host-side orchestration that materializes
-per-shard numpy views (split, merge, migration packaging, analysis
-refresh) currently runs on process 0's data layout and asserts
-single-process when invoked multi-host — distributing those host stages
-across processes is the designed next step (each process already only
-needs ITS shards' views; the package exchange maps to a DCN
-all-to-all).
+What stays single-host: the full-view fallback stages (split, merge,
+full-mesh migration oracle) assert single-process via
+:func:`require_single_process` rather than silently computing on a
+partial device view.
 
-This module is exercised in CI only in its single-process degenerate
-form (the image has one host); the multi-process paths follow the
-documented jax.distributed contract.
+:func:`pull_host` remains as the METERED escape hatch: every
+process_allgather it performs counts ``mh.allgather_bytes``, and one
+reached inside a :func:`hot_path` section additionally counts
+``mh.hot_allgather_bytes`` (the ``--multihost`` gate asserts that
+counter is ZERO) and raises under PARMMG_MH_STRICT — a stray allgather
+on the hot path fails the gate, it does not just slow the run.  The
+static mirror of the same tripwire is lint rule R7
+(parmmg_tpu/lint/rules_hostsync.py).
 """
 from __future__ import annotations
 
+import contextlib
 import os
 
 import numpy as np
@@ -46,6 +54,14 @@ def init_multihost(coordinator: str | None = None,
     Returns True if a multi-process runtime was initialized; False for
     the single-process degenerate case (no-op — the NP=1 column of the
     reference CI matrix).  Safe to call twice.
+
+    Pod wiring performed here, BEFORE the backend client exists:
+    cross-process CPU collectives (jax refuses multiprocess CPU
+    computations without an implementation; PARMMG_MH_COLLECTIVES,
+    default gloo) and the shared persistent compile cache
+    (PARMMG_MH_CACHE_DIR — the explicit opt-in path of
+    ``set_cache_env``, so the pinned-CPU dev pod behaves like the chip
+    pod: one worker compiles, the others deserialize).
     """
     import jax
 
@@ -54,8 +70,35 @@ def init_multihost(coordinator: str | None = None,
         num_processes = int(os.environ.get("JAX_NUM_PROCESSES", "1"))
     if process_id is None:
         process_id = int(os.environ.get("JAX_PROCESS_ID", "0"))
+    cache = os.environ.get("PARMMG_MH_CACHE_DIR", "")
+    if cache:
+        # cache even the sub-second programs: the pod pays hundreds of
+        # small eager-op compiles whose sum dwarfs any deserialize cost
+        os.environ.setdefault(
+            "JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0")
+        os.environ.setdefault(
+            "JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "0")
+        from ..utils.compilecache import set_cache_env
+        from ..utils.jaxcompat import multiprocess_cache_key_shim
+        set_cache_env(cache)
+        # without this shim worker N+1 misses every entry worker 0
+        # wrote (per-process autotune-cache mode + serialized topology
+        # poison the key — jaxcompat.multiprocess_cache_key_shim)
+        multiprocess_cache_key_shim()
     if not coordinator or num_processes <= 1:
+        # single-process degenerate pod: still wire the shared cache
+        # (the 1-process parity reference of multihost_run warms its
+        # own program family once per scenario)
+        if cache:
+            from ..utils.compilecache import enable_persistent_cache
+            enable_persistent_cache(cache)
         return False
+    impl = os.environ.get("PARMMG_MH_COLLECTIVES", "gloo")
+    if impl and impl != "none":
+        try:
+            jax.config.update("jax_cpu_collectives_implementation", impl)
+        except Exception:
+            pass            # other jax versions: backend handles it
     try:
         jax.distributed.initialize(
             coordinator_address=coordinator,
@@ -65,12 +108,70 @@ def init_multihost(coordinator: str | None = None,
         if "already initialized" in str(e).lower():
             return True
         raise
+    if cache:
+        from ..utils.compilecache import enable_persistent_cache
+        enable_persistent_cache(cache)
     return True
 
 
 def is_multiprocess() -> bool:
     import jax
     return jax.process_count() > 1
+
+
+# ---------------------------------------------------------------------------
+# hot-path metering (the pull_host escape hatch's tripwire)
+# ---------------------------------------------------------------------------
+_HOT_DEPTH = [0]
+
+
+@contextlib.contextmanager
+def hot_path():
+    """Mark a section as the multi-host HOT PATH: any process_allgather
+    ``pull_host`` performs inside it is counted on
+    ``mh.hot_allgather_bytes`` (gate-asserted zero) and raises under
+    PARMMG_MH_STRICT.  The per-iteration body of
+    ``distributed_adapt_multi`` runs inside one."""
+    _HOT_DEPTH[0] += 1
+    try:
+        yield
+    finally:
+        _HOT_DEPTH[0] -= 1
+
+
+@contextlib.contextmanager
+def cold_io():
+    """Exempt a nested IO section (checkpoint write, artifact dump)
+    from hot-path metering: replicating state for durable output is the
+    designed cost of that path, not a stray hot-loop allgather."""
+    d, _HOT_DEPTH[0] = _HOT_DEPTH[0], 0
+    try:
+        yield
+    finally:
+        _HOT_DEPTH[0] = d
+
+
+def in_hot_path() -> bool:
+    return _HOT_DEPTH[0] > 0
+
+
+def _note_allgather(nbytes: int, what: str = "") -> None:
+    """Meter one escape-hatch allgather (factored for host-only tests):
+    total bytes always; hot-path bytes + trace event + the
+    PARMMG_MH_STRICT tripwire when inside :func:`hot_path`."""
+    from ..obs import trace as otrace
+    from ..obs.metrics import REGISTRY
+    REGISTRY.counter("mh.allgather_bytes").inc(float(nbytes))
+    if in_hot_path():
+        REGISTRY.counter("mh.hot_allgather_bytes").inc(float(nbytes))
+        otrace.event("mh.hot_allgather", nbytes=int(nbytes),
+                     what=str(what))
+        if os.environ.get("PARMMG_MH_STRICT", "") == "1":
+            raise RuntimeError(
+                f"hot-path process_allgather of {nbytes} bytes"
+                + (f" ({what})" if what else "")
+                + " — the pod band path must route through "
+                "pod.gather_band [PARMMG_MH_STRICT]")
 
 
 # cached resharding identities keyed by the target sharding (compile
@@ -83,6 +184,8 @@ _RESHARD_CACHE: dict = {}
 
 
 def _reshard_identity(sh):
+    # lint: ok(R2) — device-id METADATA (sharding.mesh.devices is a
+    # host numpy object array), no device sync
     key = (tuple(d.id for d in np.asarray(sh.mesh.devices).flat),
            str(sh.spec))
     fn = _RESHARD_CACHE.get(key)
@@ -121,6 +224,8 @@ def shard_stacked_global(stacked_host, dmesh):
             # raise on non-addressable shards — reshard with the cached
             # jitted identity instead (XLA inserts the collectives)
             return _reshard_identity(sh)(x)
+        # lint: ok(R2) — input is the HOST-resident stacked pytree
+        # (numpy or addressable upload staging), never a device pull
         x = np.asarray(x)
         if x.shape[0] % len(devs):
             raise ValueError(
@@ -151,25 +256,30 @@ def require_single_process(what: str) -> None:
             "next step documented in parallel/multihost.py")
 
 
-def pull_host(x) -> np.ndarray:
-    """Device -> host pull that is correct on a multi-process runtime.
+def pull_host(x, what: str = "") -> np.ndarray:
+    """Device -> host pull that is correct on a multi-process runtime —
+    and METERED: the band path's hot-loop stages must ride
+    ``pod.gather_band`` instead, this is the escape hatch.
 
-    Single-process (or an already fully-addressable / replicated array):
-    plain ``np.asarray``.  Multi-process with a 'shard'-sharded global
-    array: every process holds only its addressable slices, so the pull
-    is a ``process_allgather`` — each process receives the full value
-    and the host stages compute identically everywhere (the reference's
-    every-rank-agrees idiom: its host decisions ride MPI_Allreduce/
-    Allgather the same way, e.g. the distributegrps_pmmg.c:1631
-    metadata exchange).  Band-path tables are band/interface-sized, so
-    replicating them is DCN-cheap; the full-view fallback paths must NOT
-    be pulled this way (guarded by require_single_process at their
-    entry)."""
+    Single-process (or an already fully-addressable / fully-replicated
+    array): plain ``np.asarray``.  Multi-process with a 'shard'-sharded
+    global array: every process holds only its addressable slices, so
+    the pull is a ``process_allgather`` — each process receives the
+    full value and the host stages compute identically everywhere (the
+    reference's every-rank-agrees idiom, distributegrps_pmmg.c:1631).
+    Every such allgather bumps ``mh.allgather_bytes``; inside a
+    :func:`hot_path` section it additionally bumps
+    ``mh.hot_allgather_bytes`` (asserted ZERO by ``run_tests.sh
+    --multihost``) and raises under PARMMG_MH_STRICT."""
     import jax
     if isinstance(x, np.ndarray):
         return x
     if jax.process_count() == 1 or not isinstance(x, jax.Array) \
-            or x.is_fully_addressable:
+            or x.is_fully_addressable or x.is_fully_replicated:
         return np.asarray(x)
+    _note_allgather(int(np.prod(x.shape)) * x.dtype.itemsize, what)
     from jax.experimental import multihost_utils
+    # lint: ok(R7) — pull_host IS the metered escape hatch (module
+    # docstring): the allgather is counted above and trips the
+    # PARMMG_MH_STRICT / gate assertions when reached hot
     return np.asarray(multihost_utils.process_allgather(x, tiled=True))

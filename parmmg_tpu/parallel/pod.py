@@ -1,0 +1,327 @@
+"""Pod runtime: collective-native multi-host band exchange + group
+handoff.
+
+The multi-process story before this module (round 4 / MULTIHOST2P_r04)
+was correct but allgather-shaped: every host stage of the band path
+pulled its compacted device tables through
+``multihost.pull_host`` — a ``process_allgather`` per LEAF per call,
+each building its own jitted gather, on a run that was already
+compile-dominated (656 s for a 384-tet toy).  ParMmg's equivalent
+stages ride per-neighbor ``MPI_Sendrecv``/``Alltoall`` of packed
+band payloads (distributegrps_pmmg.c:1631-1841); the JAX-native
+analogue is ONE compiled ``shard_map`` collective per table family.
+
+This module is that layer:
+
+- :func:`gather_band` — the one exchange every hot-path host stage
+  routes through.  Single-process it is a plain host view (the
+  degenerate collective); multi-process it runs a CACHED
+  ``shard_map`` ``all_gather`` program whose static shapes are the
+  callers' band budgets — all of which already ride the compile
+  governor's geo/pow2 ladders (``comms.packed_halo_rows`` /
+  ``pad_comm_tables`` / the ``KB/KV/KF/KW`` probe budgets), so the
+  exchange adds a BOUNDED program family instead of one fresh
+  ``process_allgather`` jit per leaf per iteration.  Every call is a
+  ``multihost.exchange`` faultpoint riding ``retry_call``; exhaustion
+  degrades to the metered ``pull_host`` escape hatch (ladder step
+  ``mh_allgather``) — bit-identical output, visibly counted.
+- :func:`plan_handoff` / :func:`maybe_handoff` — host-to-host group
+  migration (the ``distributegrps`` role at process granularity): a
+  logical shard (group) is handed to another device — and thereby
+  another process — as one compiled leading-axis permutation
+  (``distribute.permute_shards``), with the comm tables and host
+  numbering mirrors remapped in lockstep.  Off by default
+  (``PARMMG_MH_HANDOFF``): a handoff reorders arrival slots in later
+  migrations, so the bit-for-bit 1-vs-N-process parity contract is
+  pinned with handoff off.
+- :func:`activate` / :func:`current` — the pod context (device mesh +
+  logical-shard topology) the distributed driver threads through the
+  iteration loop so the exchange sites need no signature churn.
+
+Worker crash/stall is the EXPECTED failure mode at pod scale: a
+process that dies mid-collective takes the step down with it, the
+survivors' gloo ops time out, and the run restarts from the last
+per-pass checkpoint (``PARMMG_CKPT_DIR`` — resilience/checkpoint.py,
+wired through ``distributed_adapt_multi(..., resume=True)`` and
+``scripts/multihost_run.py --resume``).  In-process transients (the
+chaos gate's arm) recover through retry/fallback without a restart.
+"""
+from __future__ import annotations
+
+import contextlib
+import os
+
+import numpy as np
+
+from ..obs import trace as otrace
+from ..obs.metrics import REGISTRY
+from ..utils.compilecache import governed
+
+
+# ---------------------------------------------------------------------------
+# pod context
+# ---------------------------------------------------------------------------
+class PodContext:
+    """Device mesh + logical-shard topology of one distributed run.
+
+    ``n_shards`` logical shards (groups) over ``n_dev`` devices, G
+    consecutive leading-axis rows per device; a row's process is
+    ``dmesh`` device ``row // G``'s ``process_index``.  The compiled
+    exchange programs live in the module-level ``_GATHER_CACHE`` keyed
+    by this context's ``dev_key`` + the leaf shapes."""
+
+    def __init__(self, dmesh, n_shards: int):
+        import jax
+        self.dmesh = dmesh
+        self.n_shards = int(n_shards)
+        self.n_dev = int(np.asarray(dmesh.devices).size)
+        self.G = max(1, self.n_shards // max(self.n_dev, 1))
+        self.nproc = jax.process_count()
+        self.pid = jax.process_index()
+        # lint: ok(R2) — device-id metadata (cache key), no device sync
+        self.dev_key = tuple(
+            d.id for d in np.asarray(dmesh.devices).flat)
+
+    def multi(self) -> bool:
+        return self.nproc > 1
+
+
+_ACTIVE: list = []
+
+
+@contextlib.contextmanager
+def activate(dmesh, n_shards: int):
+    """Install the pod context for one driver invocation (the band
+    exchange sites read it via :func:`current` — no signature churn
+    through migrate_dev's call tree)."""
+    ctx = PodContext(dmesh, n_shards)
+    _ACTIVE.append(ctx)
+    try:
+        yield ctx
+    finally:
+        _ACTIVE.pop()
+
+
+def current() -> PodContext | None:
+    return _ACTIVE[-1] if _ACTIVE else None
+
+
+# ---------------------------------------------------------------------------
+# the band exchange
+# ---------------------------------------------------------------------------
+def exchange_key(arrays) -> tuple:
+    """Compile key of one exchange family: the (shape, dtype) tuple of
+    its leaves.  Stable across iterations because every band table is
+    budget-bucketed upstream (``KB/KV/KF/KW`` probe budgets, the
+    ``pad_comm_tables`` geo/pow2 ladders) — the same anti-churn ladders
+    that bound the halo-exchange families bound the exchange here."""
+    return tuple((tuple(np.shape(a)), str(np.asarray(a).dtype)
+                  if isinstance(a, np.ndarray) else str(a.dtype))
+                 for a in arrays)
+
+
+# compiled exchange programs keyed by (device ids, leaf shapes/dtypes)
+# — module-level so repeated driver invocations on the same mesh reuse
+# the jit objects instead of retracing per run (the DistSteps rationale)
+_GATHER_CACHE: dict = {}
+
+
+def _gather_program(ctx: PodContext, arrays):
+    import jax
+    from jax.sharding import PartitionSpec as P
+    from ..utils.jaxcompat import shard_map
+
+    key = (ctx.dev_key,) + exchange_key(arrays)
+    fn = _GATHER_CACHE.get(key)
+    if fn is None:
+        n = len(arrays)
+
+        def body(*xs):
+            return tuple(jax.lax.all_gather(x, "shard", axis=0,
+                                            tiled=True) for x in xs)
+
+        fn = shard_map(body, mesh=ctx.dmesh,
+                       in_specs=(P("shard"),) * n,
+                       out_specs=(P(),) * n, check_vma=False)
+        fn = governed("mh.band_exchange", budget=24)(jax.jit(fn))
+        _GATHER_CACHE[key] = fn
+    return fn
+
+
+def _exchange(arrays) -> tuple:
+    """One packed band exchange: replicate the compacted device tables
+    to every process through ONE compiled collective (multi-process) or
+    a plain host view (the single-controller degenerate form)."""
+    import jax
+    ctx = current()
+    if ctx is None or not ctx.multi():
+        # single-controller degenerate exchange: the tables are fully
+        # addressable; np.asarray IS the collective's identity form
+        # lint: ok(R2) — band/interface-sized compacted tables only;
+        # this IS the designed exchange (pod module docstring), the
+        # O(mesh) views stay behind require_single_process
+        return tuple(np.asarray(x) for x in arrays)
+    fn = _gather_program(ctx, arrays)
+    out = fn(*arrays)
+    host = tuple(np.asarray(x) for x in out)      # replicated outputs
+    REGISTRY.counter("mh.band_exchange_bytes").inc(
+        float(sum(h.nbytes for h in host)))
+    return host
+
+
+def gather_band(*arrays, what: str = ""):
+    """Replicate band-sized device tables to every process's host.
+
+    The ONE exchange surface of the multi-host hot path (module
+    docstring).  ``what`` labels the site for fault keying and trace.
+    Returns host numpy arrays (a single array for a single input).
+
+    Failure semantics: each attempt is a ``multihost.exchange``
+    faultpoint; ``retry_call`` re-attempts under PARMMG_RETRY_*, and
+    exhaustion falls back to the metered ``pull_host`` escape hatch
+    (ladder step ``mh_allgather``) — bit-identical values, counted
+    bytes, never a silent divergence."""
+    from ..resilience.faults import faultpoint
+    from ..resilience.recover import (RetryBudgetExhausted, ladder_step,
+                                      retry_call)
+
+    def attempt():
+        faultpoint("multihost.exchange", key=what or None)
+        return _exchange(arrays)
+
+    try:
+        out = retry_call(attempt, site="multihost.exchange")
+    except RetryBudgetExhausted as e:
+        ctx = current()
+        if ctx is not None and ctx.multi():
+            # cross-process a divergent local fallback would DESYNC the
+            # SPMD step (the other ranks are parked inside the
+            # collective): let the worker die — crash-and-resume from
+            # the per-pass checkpoint IS the ladder at pod scale
+            # (module docstring; scripts/multihost_run.py drill)
+            raise
+        ladder_step("mh_allgather", site="multihost.exchange",
+                    detail=f"{what}: {e!r}")
+        from .multihost import pull_host
+        # lint: ok(R7) — this IS the documented mh_allgather ladder
+        # rung: exchange exhausted retries, degrade to the metered
+        # escape hatch (bit-identical values, counted bytes)
+        out = tuple(pull_host(x) for x in arrays)
+    return out[0] if len(out) == 1 else out
+
+
+# ---------------------------------------------------------------------------
+# host-to-host group handoff (distributegrps at process granularity)
+# ---------------------------------------------------------------------------
+def handoff_enabled() -> bool:
+    return os.environ.get("PARMMG_MH_HANDOFF", "") == "1"
+
+
+def plan_handoff(sizes, n_dev: int,
+                 max_imbalance: float | None = None) -> np.ndarray | None:
+    """LPT re-assignment of logical shards to devices.
+
+    ``sizes``: [S_l] live-tet count per logical shard.  Returns the
+    permutation ``perm`` (new leading-axis position -> old logical row,
+    G rows per device preserved, rows within a device in ascending old
+    order for determinism) or None when the current placement is
+    already within ``max_imbalance`` (knob PARMMG_MH_IMBALANCE,
+    default 0.25) of the mean device load — or when the greedy plan
+    does not strictly improve the bottleneck."""
+    sizes = np.asarray(sizes, np.int64).reshape(-1)
+    S_l = len(sizes)
+    if n_dev <= 1 or S_l % n_dev:
+        return None
+    G = S_l // n_dev
+    if max_imbalance is None:
+        max_imbalance = float(
+            os.environ.get("PARMMG_MH_IMBALANCE", "0.25"))
+    load = sizes.reshape(n_dev, G).sum(axis=1)
+    mean = float(load.mean())
+    if mean <= 0 or float(load.max()) - mean <= max_imbalance * mean:
+        return None
+    order = np.argsort(-sizes, kind="stable")
+    dev_rows: list[list[int]] = [[] for _ in range(n_dev)]
+    dev_load = np.zeros(n_dev, np.int64)
+    for r in order:
+        free = [d for d in range(n_dev) if len(dev_rows[d]) < G]
+        d = min(free, key=lambda i: (int(dev_load[i]), i))
+        dev_rows[d].append(int(r))
+        dev_load[d] += sizes[r]
+    if int(dev_load.max()) >= int(load.max()):
+        return None                      # no bottleneck win: stay put
+    perm = np.concatenate(
+        [np.sort(np.asarray(rows, np.int64)) for rows in dev_rows])
+    if np.array_equal(perm, np.arange(S_l)):
+        return None
+    return perm
+
+
+def permute_comms(comms, perm: np.ndarray):
+    """Remap the interface comm tables under a logical-shard
+    permutation: rows reordered (new row ``i`` = old row ``perm[i]``)
+    and every embedded logical id (nbr, owner values) rewritten through
+    the inverse map.  Item order within each pair is untouched — the
+    A.4 ordering contract survives a handoff by construction."""
+    import dataclasses
+    S_l = len(perm)
+    inv = np.empty(S_l, np.int64)
+    inv[perm] = np.arange(S_l)
+    nbr = comms.nbr[perm]
+    nbr = np.where(nbr >= 0, inv[np.clip(nbr, 0, S_l - 1)],
+                   nbr).astype(comms.nbr.dtype)
+    owner = []
+    for i in range(S_l):
+        ow = comms.owner[perm[i]]
+        owner.append(inv[np.clip(ow, 0, S_l - 1)].astype(ow.dtype))
+    return dataclasses.replace(
+        comms, nbr=nbr, node_idx=comms.node_idx[perm],
+        node_cnt=comms.node_cnt[perm], face_idx=comms.face_idx[perm],
+        face_cnt=comms.face_cnt[perm], owner=owner)
+
+
+def maybe_handoff(stacked, met_s, glo_d, glo, comms, verbose: int = 0):
+    """Rebalance logical shards across devices/processes when the load
+    skew warrants it (module docstring).  Returns (stacked, met_s,
+    glo_d, glo, comms, n_moved_groups); everything unchanged (and 0)
+    when the plan is a no-op or the handoff collective fails after
+    retries — the handoff is an optimization, skipping it preserves
+    every invariant."""
+    import jax.numpy as jnp
+    from ..resilience.recover import RetryBudgetExhausted, retry_call
+    from .distribute import permute_shards
+
+    ctx = current()
+    if ctx is None:
+        return stacked, met_s, glo_d, glo, comms, 0
+    sizes = gather_band(
+        jnp.sum(stacked.tmask, axis=1, dtype=jnp.int32),
+        what="handoff_sizes")
+    perm = plan_handoff(sizes, ctx.n_dev)
+    if perm is None:
+        return stacked, met_s, glo_d, glo, comms, 0
+    moved = int(np.sum(perm // ctx.G != np.arange(len(perm)) // ctx.G))
+    try:
+        stacked2, met2, glo_d2 = retry_call(
+            lambda: permute_shards(stacked, met_s, glo_d, perm,
+                                   ctx.dmesh),
+            site="multihost.exchange")
+    except RetryBudgetExhausted as e:
+        if ctx.multi():
+            # same invariant as gather_band's exhaustion path: one
+            # rank skipping the permutation while the others apply it
+            # desyncs every later collective — die and resume from the
+            # per-pass checkpoint instead
+            raise
+        REGISTRY.counter("mh.handoff_skipped").inc()
+        otrace.log(1, f"  ## pod handoff skipped after retries ({e!r})",
+                   err=True)
+        return stacked, met_s, glo_d, glo, comms, 0
+    glo2 = [glo[int(p)] for p in perm]
+    comms2 = permute_comms(comms, perm)
+    REGISTRY.counter("mh.handoffs").inc(moved)
+    otrace.event("mh.handoff", moved=moved, n_dev=ctx.n_dev)
+    otrace.log(2, f"  pod handoff: {moved} group(s) changed device "
+                  f"(loads {np.asarray(sizes).reshape(ctx.n_dev, -1).sum(1).tolist()})",
+               verbose=verbose)
+    return stacked2, met2, glo_d2, glo2, comms2, moved

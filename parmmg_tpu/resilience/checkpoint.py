@@ -40,11 +40,14 @@ import numpy as np
 from .faults import faultpoint
 
 __all__ = [
-    "ckpt_config", "ckpt_due", "latest_pass_checkpoint",
-    "load_pass_checkpoint", "save_pass_checkpoint", "snapshot_stacked",
+    "ckpt_config", "ckpt_due", "latest_dist_checkpoint",
+    "latest_pass_checkpoint", "load_dist_checkpoint",
+    "load_pass_checkpoint", "save_dist_checkpoint",
+    "save_pass_checkpoint", "snapshot_stacked",
 ]
 
 _CKPT_RE = re.compile(r"\.pass(\d+)\.npz$")
+_DCKPT_RE = re.compile(r"\.dpass(\d+)\.npz$")
 
 
 def ckpt_config() -> tuple[str, int]:
@@ -145,6 +148,120 @@ def snapshot_stacked(tag: str, it: int, stacked, n_groups: int) -> list:
         return []
     REGISTRY.counter("resilience.checkpoint_shards").inc(len(outs))
     return outs
+
+
+def save_dist_checkpoint(tag: str, it: int, stacked_host: dict,
+                         met_s, glo: list, top: int, comms,
+                         shared_prev, regrow: int,
+                         fingerprint: str | None = None,
+                         write: bool = True) -> str | None:
+    """Per-pass durability for the SHARD-RESIDENT distributed loop
+    (``distributed_adapt_multi``) — the pod runtime's restart unit:
+    worker crash/stall at pod scale is the EXPECTED failure mode, and
+    the survivors re-launch from here instead of re-paying the whole
+    adaptation (parallel/pod.py module docstring).
+
+    ``stacked_host``: {field: [S, ...] host array} of the stacked mesh
+    (the caller replicates via pull_host under ``multihost.cold_io`` —
+    every process participates in the collective, only process 0
+    passes ``write=True``).  The payload carries the full loop state:
+    stacked fields + metric, the host numbering mirror + session
+    counter, the comm tables (incl. per-shard owner rows) and the
+    shared-gid / regrow scalars.  Atomic + fault-absorbed exactly like
+    :func:`save_pass_checkpoint`."""
+    from ..obs import trace as otrace
+    from ..obs.metrics import REGISTRY
+    if not ckpt_due(it):
+        return None
+    d, _ = ckpt_config()
+    path = os.path.join(d, f"{tag}.dpass{it}.npz")
+    if not write:
+        return path
+    try:
+        faultpoint("io.checkpoint")
+        os.makedirs(d, exist_ok=True)
+        tmp = path + ".tmp"
+        payload = {f"m_{k}": np.asarray(v)
+                   for k, v in stacked_host.items()}
+        payload.update(
+            it=np.asarray(it, np.int64),
+            fp=np.asarray(fingerprint or ""),
+            met=np.asarray(met_s),
+            glo=np.stack([np.asarray(g) for g in glo]),
+            top=np.asarray(int(top), np.int64),
+            nbr=comms.nbr, node_idx=comms.node_idx,
+            node_cnt=comms.node_cnt, face_idx=comms.face_idx,
+            face_cnt=comms.face_cnt,
+            shared_prev=np.asarray(shared_prev),
+            regrow=np.asarray(int(regrow), np.int64))
+        for s, ow in enumerate(comms.owner):
+            payload[f"owner_{s}"] = np.asarray(ow)
+        with open(tmp, "wb") as fh:
+            np.savez(fh, **payload)
+        os.replace(tmp, path)
+    except Exception as e:
+        try:
+            os.unlink(path + ".tmp")
+        except OSError:
+            pass
+        REGISTRY.counter("resilience.checkpoint_failures").inc()
+        otrace.event("ckpt.failed", tag=tag, it=it, detail=repr(e)[:300])
+        otrace.log(1, f"  ## Warning: dist pass checkpoint failed "
+                      f"({e!r}); run continues unprotected.", err=True)
+        return None
+    REGISTRY.counter("resilience.checkpoints").inc()
+    otrace.event("ckpt.saved", tag=tag, it=it, path=path)
+    return path
+
+
+def latest_dist_checkpoint(tag: str, fingerprint: str | None = None
+                           ) -> tuple[str, int] | None:
+    """Newest complete dist-loop (path, pass index) for ``tag``; same
+    staleness/partial-file rules as :func:`latest_pass_checkpoint`."""
+    from ..obs import trace as otrace
+    d, _ = ckpt_config()
+    if not d or not os.path.isdir(d):
+        return None
+    found = []
+    for name in os.listdir(d):
+        if not name.startswith(tag + ".dpass"):
+            continue
+        m = _DCKPT_RE.search(name)
+        if m:
+            found.append((int(m.group(1)), os.path.join(d, name)))
+    for it, path in sorted(found, reverse=True):
+        try:
+            with np.load(path) as z:
+                if "m_vert" not in z.files or int(z["it"]) != it:
+                    continue
+                if fingerprint is not None:
+                    stored = str(z["fp"]) if "fp" in z.files else ""
+                    if stored != fingerprint:
+                        otrace.log(1, f"  ## Warning: checkpoint "
+                                      f"{path} belongs to a different "
+                                      "run (input fingerprint "
+                                      "mismatch); skipped.", err=True)
+                        continue
+                return path, it
+        except Exception:
+            continue
+    return None
+
+
+def load_dist_checkpoint(path: str) -> dict:
+    """Dist checkpoint -> {stacked: {field: array}, met, glo (list),
+    top, comms: InterfaceComms, shared_prev, regrow, it}."""
+    from ..parallel.comms import InterfaceComms
+    z = np.load(path)
+    stacked = {k[2:]: z[k] for k in z.files if k.startswith("m_")}
+    S = z["glo"].shape[0]
+    owner = [z[f"owner_{s}"] for s in range(S)]
+    comms = InterfaceComms(z["nbr"], z["node_idx"], z["node_cnt"],
+                           z["face_idx"], z["face_cnt"], owner)
+    return dict(stacked=stacked, met=z["met"],
+                glo=[g.copy() for g in z["glo"]], top=int(z["top"]),
+                comms=comms, shared_prev=z["shared_prev"],
+                regrow=int(z["regrow"]), it=int(z["it"]))
 
 
 def latest_pass_checkpoint(tag: str, fingerprint: str | None = None

@@ -53,6 +53,7 @@ SITES = {
     "polish.worker": "exit",
     "dispatch.chunk": "xla",
     "halo.exchange": "xla",
+    "multihost.exchange": "xla",
     "analysis.ks_overflow": "flag",
     "serve.slot_step": "xla",
     "serve.daemon_rpc": "os",

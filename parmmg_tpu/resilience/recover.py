@@ -22,7 +22,11 @@ the shared spine:
 Ladder order (least to most degraded; each step preserves the
 conforming-mesh invariant):
 
-    retry          re-run the failed unit (chunk dispatch / worker)
+    retry          re-run the failed unit (chunk dispatch / worker /
+                   band exchange)
+    mh_allgather   pod band-exchange collective failed -> metered
+                   pull_host allgather (bit-identical values, counted
+                   bytes — parallel/pod.py escape hatch)
     halo_dense     packed halo exchange failed -> dense layout retry
     host_analysis  device analysis refresh failed/overflowed -> host
     merged_polish  grouped polish worker gone -> skip, the caller's
@@ -41,8 +45,8 @@ __all__ = [
     "retry_call", "retry_env",
 ]
 
-LADDER = ("retry", "halo_dense", "host_analysis", "merged_polish",
-          "lowfailure")
+LADDER = ("retry", "mh_allgather", "halo_dense", "host_analysis",
+          "merged_polish", "lowfailure")
 
 # deterministic capacity signals must not be retried: re-running the
 # identical program reproduces the identical overflow

@@ -91,3 +91,46 @@ def platform_dependent(*args, default=None, **platform_branches):
             *args, default=default, **platform_branches)
     fn = platform_branches.get(jax.default_backend(), default)
     return fn(*args)
+
+
+def multiprocess_cache_key_shim() -> bool:
+    """Make persistent-compile-cache keys PROCESS-INVARIANT on the
+    pinned jax (0.4.37) so pod workers share one warmed cache
+    (parallel/multihost.init_multihost).
+
+    Two per-process key poisons on this jax, both empirically verified
+    to make worker N+1 MISS every entry worker 0 wrote:
+
+    - the XLA-side autotune-cache mode rides the hashed debug options
+      and is UPDATE on process 0 but READ everywhere else
+      (jax._src.compiler.get_compile_options) — disabled outright via
+      ``jax_persistent_cache_enable_xla_caches="none"`` (those caches
+      are GPU-oriented; the pod dev backend is CPU);
+    - ``cache_key._hash_accelerator_config`` hashes the SERIALIZED
+      PjRt topology, which embeds per-process structure — replaced by
+      the module's own documented fallback (device kinds + platform),
+      which is process-invariant.  Topology differences that matter
+      for compilation still key correctly through the device
+      assignment inside the hashed compile options.
+
+    Returns True when the shim applied.  Idempotent."""
+    import jax
+    try:
+        jax.config.update("jax_persistent_cache_enable_xla_caches",
+                          "none")
+    except Exception:
+        pass                        # newer jax: key already invariant
+    try:
+        from jax._src import cache_key as _ck
+        if getattr(_ck, "_parmmg_invariant_accel", False):
+            return True
+
+        def _invariant_accel(hash_obj, accelerators, backend):
+            _ck._hash_devices(hash_obj, accelerators)
+            _ck._hash_platform(hash_obj, backend)
+
+        _ck._hash_accelerator_config = _invariant_accel
+        _ck._parmmg_invariant_accel = True
+        return True
+    except Exception:               # pragma: no cover - future jax
+        return False

@@ -45,6 +45,14 @@ from contextlib import contextmanager
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 os.environ["JAX_PLATFORMS"] = "cpu"
+# 2 virtual devices: scenario 8 runs the 2-shard distributed path
+# (multihost.exchange faultpoint); the grouped scenarios are
+# single-device and unaffected by the extra virtual device
+if "xla_force_host_platform_device_count" not in \
+        os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=2").strip()
 os.environ.pop("JAX_COMPILATION_CACHE_DIR", None)
 for _k in ("PARMMG_FAULT", "PARMMG_CKPT_DIR", "PARMMG_TRACE"):
     os.environ.pop(_k, None)
@@ -408,6 +416,44 @@ def main() -> int:
     check(outs_d1.get("t0") == outs_a["t0"]
           and outs_d1.get("t2") == outs_a["t2"],
           "cohort-mates of the killed request retire bit-identical")
+
+    # ---- 8. multihost.exchange: band-exchange fault ladder -------------
+    # (single-process arm of the pod failure semantics: transient ->
+    # retry rung; exhausted -> mh_allgather escape hatch, both
+    # bit-identical.  The cross-process arm — worker death -> resume
+    # from the per-pass checkpoint — is run_tests.sh --multihost.)
+    print("--- chaos gate: multihost.exchange band-exchange fault")
+    from parmmg_tpu.parallel.dist import distributed_adapt_multi
+
+    def run_dist():
+        m, met = fresh_case()
+        out, met_m, _ = distributed_adapt_multi(m, met, 2, niter=2,
+                                                cycles=CYCLES)
+        return state_bytes(out, met_m)
+
+    base_d = run_dist()
+    c0 = counters()
+    mark = ring_mark()
+    with env(PARMMG_FAULT="multihost.exchange:nth-1",
+             PARMMG_RETRY_MAX="2"):
+        got = run_dist()
+    check(got == base_d,
+          "nth-1 exchange fault recovered bit-for-bit (retry rung)")
+    check(delta(c0, "resilience.faults_injected") >= 1,
+          "exchange fault actually injected")
+    check("retry" in ladder_steps_since(mark),
+          "retry ladder event emitted")
+    c0 = counters()
+    mark = ring_mark()
+    with env(PARMMG_FAULT="multihost.exchange", PARMMG_RETRY_MAX="0"):
+        got2 = run_dist()
+    check(got2 == base_d,
+          "exhausted exchange degrades to the metered allgather "
+          "bit-for-bit")
+    check("mh_allgather" in ladder_steps_since(mark),
+          "mh_allgather ladder step recorded")
+    check(delta(c0, "resilience.mh_allgather") >= 1,
+          "resilience.mh_allgather counter bumped")
 
     # ---- verdict -------------------------------------------------------
     if FAILS:

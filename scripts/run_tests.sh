@@ -23,7 +23,8 @@ fi
 # The compile-heavy gates below pay minutes of XLA:CPU compile — run
 # the seconds-cheap static lint first so hygiene violations fail fast.
 if [ "${1:-}" = "--ledger" ] || [ "${1:-}" = "--obs" ] \
-        || [ "${1:-}" = "--chaos" ] || [ "${1:-}" = "--serve" ]; then
+        || [ "${1:-}" = "--chaos" ] || [ "${1:-}" = "--serve" ] \
+        || [ "${1:-}" = "--multihost" ]; then
     python scripts/lint_check.py || exit 1
 fi
 
@@ -70,6 +71,17 @@ fi
 # the standalone warmup, then a clean shutdown (threads joined).
 if [ "${1:-}" = "--serve" ]; then
     exec env JAX_PLATFORMS=cpu python scripts/serve_check.py
+fi
+
+# --multihost: pod-runtime gate (scripts/multihost_check.py) — a
+# 2-process localhost run must be bit-identical to the 1-process dist
+# path, every worker must pay ~zero compiles through the shared warm
+# cache, the hot path must perform ZERO process_allgather bytes
+# (mh.hot_allgather_bytes), and a worker killed mid-run must resume
+# from its per-pass checkpoint bit-identically.  First invocation
+# warms the repo-local .jax_cache_mh; repeats run warm.
+if [ "${1:-}" = "--multihost" ]; then
+    exec env JAX_PLATFORMS=cpu python scripts/multihost_check.py
 fi
 
 fail=0

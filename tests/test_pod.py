@@ -1,0 +1,292 @@
+"""Host-only tests for the pod runtime (parallel/pod.py): the band
+exchange plan (family keys, packing/pad semantics, fault ladder), the
+pull_host hot-path meter, the glo-mirror delta-sync helpers and the
+host-to-host group-handoff plan.  Everything here is numpy / host
+bookkeeping — no compiled exchange runs (the 2-process collective path
+is run_tests.sh --multihost; the in-process fault arms are --chaos)."""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+import pytest
+
+from parmmg_tpu.parallel import pod
+from parmmg_tpu.parallel.multihost import (_note_allgather, cold_io,
+                                           hot_path, in_hot_path)
+from parmmg_tpu.resilience.faults import FAULTS
+
+
+@pytest.fixture
+def fault_env():
+    """Scoped PARMMG_* overrides + fault-registry reset both ways."""
+    saved = {}
+
+    def set_env(**kv):
+        for k, v in kv.items():
+            saved.setdefault(k, os.environ.get(k))
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = str(v)
+        FAULTS.reset()
+
+    yield set_env
+    for k, v in saved.items():
+        if v is None:
+            os.environ.pop(k, None)
+        else:
+            os.environ[k] = v
+    FAULTS.reset()
+
+
+def counters():
+    from parmmg_tpu.obs.metrics import REGISTRY
+    return dict(REGISTRY.snapshot()["counters"])
+
+
+# ---------------------------------------------------------------------------
+# exchange plan: family keys + anti-churn bucketing
+# ---------------------------------------------------------------------------
+def test_exchange_key_stable_and_distinct():
+    a = np.zeros((4, 8), np.int32)
+    b = np.zeros((4,), np.int32)
+    k1 = pod.exchange_key((a, b))
+    k2 = pod.exchange_key((np.ones((4, 8), np.int32),
+                           np.ones((4,), np.int32)))
+    assert k1 == k2                      # values never key a family
+    assert pod.exchange_key((a,)) != k1
+    assert pod.exchange_key((a.astype(np.int64), b)) != k1
+    assert pod.exchange_key((np.zeros((4, 16), np.int32), b)) != k1
+
+
+def test_exchange_families_ride_the_comm_table_ladders():
+    """Drifting interface sizes must land on ONE exchange family: the
+    comm tables are bucketed by pad_comm_tables' geo/pow2 ladders, so
+    the (shape, dtype) exchange keys they produce are churn-free."""
+    from parmmg_tpu.parallel.comms import pad_comm_tables
+    keys = set()
+    for n_items in (33, 41, 57, 60):     # drifts within one geo bucket
+        nl = [[[], list(range(n_items))], [list(range(n_items)), []]]
+        fl = [[[], list(range(n_items))], [list(range(n_items)), []]]
+        ow = [np.zeros(8, np.int32), np.zeros(8, np.int32)]
+        c = pad_comm_tables(nl, fl, ow, 2)
+        keys.add(pod.exchange_key((c.node_idx, c.face_idx, c.nbr)))
+    assert len(keys) == 1, keys
+
+
+# ---------------------------------------------------------------------------
+# gather_band: degenerate exchange + fault ladder (single-process arm)
+# ---------------------------------------------------------------------------
+def test_gather_band_passthrough_bit_identity():
+    a = np.arange(12, dtype=np.int32).reshape(4, 3)
+    b = np.arange(4, dtype=np.float64)
+    ga, gb = pod.gather_band(a, b, what="t")
+    assert ga.tobytes() == a.tobytes() and gb.tobytes() == b.tobytes()
+    # single input returns the bare array, not a 1-tuple
+    g = pod.gather_band(a)
+    assert isinstance(g, np.ndarray) and g.tobytes() == a.tobytes()
+
+
+def test_gather_band_transient_fault_retries(fault_env):
+    fault_env(PARMMG_FAULT="multihost.exchange:nth-1",
+              PARMMG_RETRY_MAX="2", PARMMG_RETRY_BASE_S="0")
+    a = np.arange(8, dtype=np.int32)
+    c0 = counters()
+    out = pod.gather_band(a, what="t")
+    assert out.tobytes() == a.tobytes()
+    c1 = counters()
+    assert c1.get("resilience.faults_injected", 0) \
+        > c0.get("resilience.faults_injected", 0)
+    assert c1.get("resilience.retry", 0) > c0.get("resilience.retry", 0)
+
+
+def test_gather_band_exhaustion_takes_the_metered_hatch(fault_env):
+    fault_env(PARMMG_FAULT="multihost.exchange",
+              PARMMG_RETRY_MAX="0", PARMMG_RETRY_BASE_S="0")
+    a = np.arange(8, dtype=np.int32)
+    c0 = counters()
+    out = pod.gather_band(a, what="t")
+    assert out.tobytes() == a.tobytes()      # bit-identical fallback
+    c1 = counters()
+    assert c1.get("resilience.mh_allgather", 0) \
+        > c0.get("resilience.mh_allgather", 0)
+
+
+def test_gather_band_key_matched_fault_only_fires_on_its_site(
+        fault_env):
+    fault_env(PARMMG_FAULT="multihost.exchange:key=extend",
+              PARMMG_RETRY_MAX="0", PARMMG_RETRY_BASE_S="0")
+    a = np.arange(4, dtype=np.int32)
+    c0 = counters()
+    pod.gather_band(a, what="faces")         # non-matching: clean
+    assert counters().get("resilience.mh_allgather", 0) \
+        == c0.get("resilience.mh_allgather", 0)
+    pod.gather_band(a, what="extend")        # matching: ladder
+    assert counters().get("resilience.mh_allgather", 0) \
+        > c0.get("resilience.mh_allgather", 0)
+
+
+# ---------------------------------------------------------------------------
+# pull_host hot-path meter
+# ---------------------------------------------------------------------------
+def test_hot_path_nesting_and_cold_io_exemption():
+    assert not in_hot_path()
+    with hot_path():
+        assert in_hot_path()
+        with hot_path():
+            assert in_hot_path()
+            with cold_io():
+                assert not in_hot_path()
+            assert in_hot_path()
+        assert in_hot_path()
+    assert not in_hot_path()
+
+
+def test_allgather_meter_counts_total_and_hot(fault_env):
+    fault_env(PARMMG_MH_STRICT=None)
+    c0 = counters()
+    _note_allgather(100, "cold")
+    c1 = counters()
+    assert c1.get("mh.allgather_bytes", 0) \
+        == c0.get("mh.allgather_bytes", 0) + 100
+    assert c1.get("mh.hot_allgather_bytes", 0) \
+        == c0.get("mh.hot_allgather_bytes", 0)
+    with hot_path():
+        _note_allgather(7, "hot")
+    c2 = counters()
+    assert c2.get("mh.hot_allgather_bytes", 0) \
+        == c1.get("mh.hot_allgather_bytes", 0) + 7
+
+
+def test_strict_knob_trips_on_hot_allgather_only(fault_env):
+    fault_env(PARMMG_MH_STRICT="1")
+    _note_allgather(1, "cold-ok")            # outside hot path: metered
+    with hot_path():
+        with pytest.raises(RuntimeError, match="PARMMG_MH_STRICT"):
+            _note_allgather(1, "hot-trip")
+        with cold_io():
+            _note_allgather(1, "ckpt-ok")    # exempted IO section
+
+
+# ---------------------------------------------------------------------------
+# glo-mirror delta sync (the O(mesh)-allgather replacement)
+# ---------------------------------------------------------------------------
+def test_mirror_delta_sync_matches_full_mask_semantics():
+    from parmmg_tpu.parallel.migrate import apply_fresh_ids, kill_glo_rows
+    rng = np.random.default_rng(0)
+    capP, S = 32, 3
+    glo = [np.where(rng.random(capP) < 0.6,
+                    np.arange(capP, dtype=np.int64) + 100 * s,
+                    -1) for s in range(S)]
+    ref = [g.copy() for g in glo]
+    vmask = [g >= 0 for g in glo]
+    # kill some live rows; reference semantics: glo[~vmask] = -1
+    dead_rows = np.full((S, 8), capP, np.int32)
+    dead_cnt = np.zeros(S, np.int32)
+    for s in range(S):
+        live = np.where(vmask[s])[0]
+        kill = live[:3]
+        vmask[s][kill] = False
+        dead_rows[s, :3] = kill
+        dead_cnt[s] = 3
+        ref[s][~vmask[s]] = -1
+    kill_glo_rows(glo, dead_rows, dead_cnt)
+    for s in range(S):
+        np.testing.assert_array_equal(glo[s], ref[s])
+    # fresh-id application ignores -1 pads
+    rows = np.full((S, 4), -1, np.int32)
+    gids = np.full((S, 4), -1, np.int32)
+    rows[0, :2] = [1, 2]
+    gids[0, :2] = [9001, 9002]
+    apply_fresh_ids(glo, rows, gids)
+    assert glo[0][1] == 9001 and glo[0][2] == 9002
+    np.testing.assert_array_equal(glo[1], ref[1])
+
+
+def test_kill_glo_rows_tolerates_pads_and_out_of_range():
+    from parmmg_tpu.parallel.migrate import kill_glo_rows
+    glo = [np.arange(8, dtype=np.int64)]
+    rows = np.array([[2, -1, 8, 99]], np.int32)   # pad / oob ignored
+    kill_glo_rows(glo, rows, np.array([4], np.int32))
+    assert glo[0][2] == -1
+    assert (glo[0][[0, 1, 3, 4, 5, 6, 7]] >= 0).all()
+
+
+# ---------------------------------------------------------------------------
+# group handoff: plan + comm-table permutation
+# ---------------------------------------------------------------------------
+def test_plan_handoff_balances_skewed_loads():
+    sizes = np.array([100, 90, 1, 1], np.int64)   # dev0 huge, dev1 idle
+    perm = pod.plan_handoff(sizes, 2, max_imbalance=0.25)
+    assert perm is not None
+    assert sorted(perm.tolist()) == [0, 1, 2, 3]  # a true permutation
+    new_loads = sizes[perm].reshape(2, 2).sum(1)
+    assert new_loads.max() < sizes.reshape(2, 2).sum(1).max()
+
+
+def test_plan_handoff_identity_when_balanced():
+    assert pod.plan_handoff(np.array([10, 11, 10, 9]), 2) is None
+    assert pod.plan_handoff(np.zeros(4, np.int64), 2) is None
+    assert pod.plan_handoff(np.array([5, 5]), 1) is None   # one device
+    assert pod.plan_handoff(np.array([1, 2, 3]), 2) is None  # ragged
+
+
+def test_plan_handoff_deterministic_and_g_preserving():
+    rng = np.random.default_rng(3)
+    sizes = rng.integers(0, 1000, size=12)
+    p1 = pod.plan_handoff(sizes, 4, max_imbalance=0.0)
+    p2 = pod.plan_handoff(sizes, 4, max_imbalance=0.0)
+    if p1 is None:
+        assert p2 is None
+    else:
+        np.testing.assert_array_equal(p1, p2)
+        assert len(p1) == 12
+        # exactly G=3 rows per device, ascending within each device
+        for d in range(4):
+            rows = p1[3 * d: 3 * (d + 1)]
+            assert (np.diff(rows) > 0).all()
+
+
+def test_permute_comms_roundtrip_and_id_remap():
+    from parmmg_tpu.parallel.comms import InterfaceComms
+    S, K, I = 4, 2, 4
+    rng = np.random.default_rng(1)
+    nbr = np.full((S, K), -1, np.int32)
+    for s in range(S):
+        nbr[s, 0] = (s + 1) % S
+    node_idx = rng.integers(-1, 6, size=(S, K, I)).astype(np.int32)
+    node_cnt = rng.integers(0, I, size=(S, K)).astype(np.int32)
+    face_idx = rng.integers(-1, 6, size=(S, K, I)).astype(np.int32)
+    face_cnt = rng.integers(0, I, size=(S, K)).astype(np.int32)
+    owner = [rng.integers(0, S, size=5).astype(np.int32)
+             for _ in range(S)]
+    c = InterfaceComms(nbr, node_idx, node_cnt, face_idx, face_cnt,
+                       owner)
+    perm = np.array([2, 3, 0, 1])
+    c2 = pod.permute_comms(c, perm)
+    # new row i describes old shard perm[i], ids remapped
+    inv = np.empty(S, np.int64)
+    inv[perm] = np.arange(S)
+    for i in range(S):
+        old = perm[i]
+        np.testing.assert_array_equal(c2.node_idx[i], node_idx[old])
+        np.testing.assert_array_equal(c2.owner[i], inv[owner[old]])
+        exp = np.where(nbr[old] >= 0, inv[np.clip(nbr[old], 0, S - 1)],
+                       nbr[old])
+        np.testing.assert_array_equal(c2.nbr[i], exp)
+    # permuting back restores the original tables
+    c3 = pod.permute_comms(c2, inv)
+    np.testing.assert_array_equal(c3.nbr, nbr)
+    np.testing.assert_array_equal(c3.node_idx, node_idx)
+    np.testing.assert_array_equal(c3.face_idx, face_idx)
+    for s in range(S):
+        np.testing.assert_array_equal(c3.owner[s], owner[s])
+
+
+def test_handoff_knobs_declared():
+    from parmmg_tpu.api import knobs
+    for k in ("PARMMG_MH_HANDOFF", "PARMMG_MH_IMBALANCE",
+              "PARMMG_MH_STRICT", "PARMMG_MH_CACHE_DIR",
+              "PARMMG_MH_COLLECTIVES"):
+        assert k in knobs.KNOBS

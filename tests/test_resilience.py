@@ -200,7 +200,8 @@ def test_retry_env_knobs(monkeypatch):
 # escalation ladder
 # ---------------------------------------------------------------------------
 def test_ladder_order_is_the_documented_escalation():
-    assert LADDER == ("retry", "halo_dense", "host_analysis",
+    assert LADDER == ("retry", "mh_allgather", "halo_dense",
+                      "host_analysis",
                       "merged_polish", "lowfailure")
 
 
