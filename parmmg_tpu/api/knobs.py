@@ -59,6 +59,24 @@ KNOBS: dict[str, Knob] = {
         "int", "",
         "override cycles per compiled adapt block (ops/adapt.py); "
         "empty = backend default"),
+    "PARMMG_DEADLINE_DISPATCH_S": Knob(
+        "float", "0",
+        "watchdog deadline on each grouped chunk dispatch/drain "
+        "(resilience/watchdog.py; 0 = off); expiry enters the retry "
+        "ladder as WatchdogTimeout"),
+    "PARMMG_DEADLINE_EXCHANGE_S": Knob(
+        "float", "0",
+        "watchdog deadline on each single-process gather_band "
+        "exchange attempt (0 = off; cross-process hangs are the "
+        "heartbeat lease's job)"),
+    "PARMMG_DEADLINE_GRACE_S": Knob(
+        "float", "300",
+        "extra seconds granted to a site's FIRST guarded call so a "
+        "cold XLA compile is not misread as a wedged warm step"),
+    "PARMMG_DEADLINE_SERVE_S": Knob(
+        "float", "0",
+        "watchdog deadline on each serve daemon loop step (0 = off); "
+        "expiry flips /healthz to wedged until the step returns"),
     "PARMMG_DEVICE_MASK": Knob(
         "flag", "1",
         "device-resident quiet masks: lax.cond-skip the wave math for "
@@ -93,6 +111,15 @@ KNOBS: dict[str, Knob] = {
         "float", "0.75",
         "measured-occupancy threshold under which the grouped halo "
         "uses the packed per-device-pair layout instead of dense"),
+    "PARMMG_HEARTBEAT_LEASE_S": Knob(
+        "float", "0",
+        "pod supervisor default for scripts/multihost_run.py --lease: "
+        "seconds without a worker heartbeat after which the pack is "
+        "killed and relaunched with resume (0 = leases off)"),
+    "PARMMG_HEARTBEAT_S": Knob(
+        "float", "2",
+        "worker heartbeat interval: minimum seconds between per-rank "
+        "heartbeat touches inside hot_path sections"),
     "PARMMG_HOST_ANALYSIS": Knob(
         "flag", "",
         "1 = skip the device analysis-refresh path and always use the "
@@ -112,6 +139,11 @@ KNOBS: dict[str, Knob] = {
         "across devices/processes between iterations (parallel/pod.py;"
         " off by default — reordering arrivals breaks bit-parity with "
         "the no-handoff run)"),
+    "PARMMG_MH_HEARTBEAT_DIR": Knob(
+        "path", "",
+        "internal supervisor->worker heartbeat directory (per-rank "
+        "hb.N files; scripts/multihost_run.py sets it under --lease); "
+        "never set by hand"),
     "PARMMG_MH_IMBALANCE": Knob(
         "float", "0.25",
         "device load skew (max/mean - 1) above which the group "
@@ -128,6 +160,11 @@ KNOBS: dict[str, Knob] = {
         "flag", "",
         "grouped polish phase in a subprocess worker (the TPU-tunnel "
         "path); empty = only on the tpu backend"),
+    "PARMMG_POLISH_TIMEOUT_S": Knob(
+        "float", "0",
+        "wall-clock timeout on the grouped polish subprocess worker "
+        "(0 = off): expiry kills the worker, unlinks its partial "
+        "output and degrades to merged_polish like a worker crash"),
     "PARMMG_PROFILE_DIR": Knob(
         "path", "",
         "arm a jax.profiler capture writing the xprof timeline into "
@@ -136,6 +173,11 @@ KNOBS: dict[str, Knob] = {
         "spec", "0",
         "outer-pass capture window start[:stop] for "
         "PARMMG_PROFILE_DIR"),
+    "PARMMG_RESUME_MAX": Knob(
+        "int", "3",
+        "crash-loop breaker: resume attempts into the SAME pass of "
+        "the same run fingerprint before escalating to lowfailure "
+        "instead of resuming again (resilience/checkpoint.crash_loop)"),
     "PARMMG_RETRY_BASE_S": Knob(
         "float", "0.05",
         "retry backoff base seconds, doubled per attempt"),
@@ -199,6 +241,14 @@ KNOBS: dict[str, Knob] = {
         "float", "0",
         "serve driver: per-request wall-clock timeout; the slot is "
         "reclaimed (0 = off)"),
+    "PARMMG_SOAK_RUNS": Knob(
+        "int", "8",
+        "scripts/chaos_soak.py default campaign length (seeded runs "
+        "with randomized fault schedules)"),
+    "PARMMG_SOAK_SEED": Knob(
+        "int", "20260804",
+        "scripts/chaos_soak.py campaign seed: the fault schedule is a "
+        "pure function of (seed, runs)"),
     "PARMMG_TEST_CACHE": Knob(
         "flag", "",
         "1 = opt the test processes into the persistent compile cache "

@@ -32,7 +32,7 @@ __all__ = ["SCHEMA_VERSION", "KINDS", "artifact_diff", "env_block",
            "make_artifact", "upgrade_artifact", "validate_artifact"]
 
 SCHEMA_VERSION = 1
-KINDS = ("BENCH", "SCALE", "SERVE", "MULTIHOST")
+KINDS = ("BENCH", "SCALE", "SERVE", "MULTIHOST", "SOAK")
 
 
 def env_block() -> dict:

@@ -42,13 +42,27 @@ import sys
 # cheap while still exercising the parent's real rc!=0 recovery path.
 # Guarded on __main__ so merely importing this module never exits.
 if __name__ == "__main__" and \
-        os.environ.get("PARMMG_FAULT_FORCE", "") == "polish.worker":
-    # lint: ok(R3) — pre-jax fast exit: this line must not import the
-    # obs spine (the whole point is dying before any heavy import);
-    # the parent relays worker stderr through obs.trace.log
-    print("injected fault: polish.worker (PARMMG_FAULT_FORCE)",
-          file=sys.stderr, flush=True)
-    raise SystemExit(3)
+        os.environ.get("PARMMG_FAULT_FORCE", "").startswith(
+            "polish.worker"):
+    _force = os.environ["PARMMG_FAULT_FORCE"]
+    _, _, _act = _force.partition(":")
+    if _act.startswith("hang="):
+        # the WEDGED-worker drill (hang=S action): sleep pre-jax, then
+        # proceed normally — the parent's PARMMG_POLISH_TIMEOUT_S is
+        # what must kill us (resilience/watchdog.py)
+        import time as _time
+        # lint: ok(R3) — pre-jax protocol line, relayed by the parent
+        # through obs.trace.log like the exit-3 arm below
+        print(f"injected hang: {_force}", file=sys.stderr, flush=True)
+        _time.sleep(float(_act[5:]))
+    else:
+        # lint: ok(R3) — pre-jax fast exit: this line must not import
+        # the obs spine (the whole point is dying before any heavy
+        # import); the parent relays worker stderr through
+        # obs.trace.log
+        print("injected fault: polish.worker (PARMMG_FAULT_FORCE)",
+              file=sys.stderr, flush=True)
+        raise SystemExit(3)
 
 import numpy as np
 

@@ -276,9 +276,17 @@ def _pipeline_chunks(fn, stacked, met_s, wave, plans, tim, done=None):
     import os
     from ..resilience.faults import faultpoint
     from ..resilience.recover import retry_call
+    from ..resilience.watchdog import deadline_knob, run_with_deadline
     from .sched import pad_mask
     depth = 2 if os.environ.get("PARMMG_GROUP_PIPELINE", "1") != "0" \
         else 1
+    # deadline watchdog on each dispatch/drain unit (0 = off, the
+    # default): a wedged device dispatch raises WatchdogTimeout into
+    # the SAME except/redo/retry path as a crashed one.  The abandoned
+    # monitor-thread attempt is harmless here: a drain's writeback is
+    # idempotent and deterministic, so a late commit racing the retry
+    # writes identical bytes (the redo contract below)
+    ddl = deadline_knob("PARMMG_DEADLINE_DISPATCH_S")
     out = [None] * len(plans)
 
     def dispatch(pi, idx, nreal):
@@ -317,15 +325,29 @@ def _pipeline_chunks(fn, stacked, met_s, wave, plans, tim, done=None):
         if done is not None:
             done[pi] = out[pi]
 
+    # the watchdog-guarded forms (inline when PARMMG_DEADLINE_DISPATCH_S
+    # is 0/unset — zero threads on the default path)
+    def gdispatch(pi, idx, nreal):
+        return run_with_deadline(lambda: dispatch(pi, idx, nreal),
+                                 ddl, "dispatch.chunk")
+
+    def gdrain(p):
+        return run_with_deadline(lambda: drain(p), ddl,
+                                 "dispatch.chunk")
+
     def redo(pi, idx, nreal, first):
         # serial dispatch+drain re-attempt of one failed chunk; the
-        # inline fast-path attempt already counted (initial_failure)
-        retry_call(lambda: drain(dispatch(pi, idx, nreal)),
-                   site="dispatch.chunk", initial_failure=first)
+        # inline fast-path attempt already counted (initial_failure).
+        # One deadline bounds the serial pair: a retry that ALSO wedges
+        # keeps feeding the retry budget until it exhausts (LOWFAILURE)
+        retry_call(lambda: run_with_deadline(
+            lambda: drain(dispatch(pi, idx, nreal)), ddl,
+            "dispatch.chunk"),
+            site="dispatch.chunk", initial_failure=first)
 
     def safe_drain(p):
         try:
-            drain(p)
+            gdrain(p)
         except Exception as e:
             redo(p[0], p[1], p[2], e)
 
@@ -333,7 +355,7 @@ def _pipeline_chunks(fn, stacked, met_s, wave, plans, tim, done=None):
     for pi, (idx, nreal) in enumerate(plans):
         cur = first = None
         try:
-            cur = dispatch(pi, idx, nreal)
+            cur = gdispatch(pi, idx, nreal)
         except Exception as e:
             first = e
         if pending is not None:
@@ -565,6 +587,9 @@ def grouped_adapt_pass(mesh: Mesh, met, ngroups: int, cycles: int = 12,
             from ..resilience.recover import (RetryBudgetExhausted,
                                               WorkerExitError,
                                               ladder_step, retry_call)
+            from ..resilience.watchdog import (WatchdogTimeout,
+                                               deadline_knob,
+                                               record_timeout)
             td = tempfile.mkdtemp(prefix="parmmg_polish_")
             try:
                 inp, outp = f"{td}/in.npz", f"{td}/out.npz"
@@ -580,16 +605,35 @@ def grouped_adapt_pass(mesh: Mesh, met, ngroups: int, cycles: int = 12,
                                       _os.pathsep + pkg_parent).lstrip(
                     _os.pathsep)
 
+                # wall-clock bound on each worker invocation (0 = off):
+                # a WEDGED worker used to hang the whole pass forever —
+                # run() kills the subprocess on expiry and the
+                # WatchdogTimeout rides the same retry -> merged_polish
+                # ladder as a crashed worker.  Size the knob for a cold
+                # worker (it pays its own compiles per invocation)
+                wdl = deadline_knob("PARMMG_POLISH_TIMEOUT_S")
+
                 def _invoke():
                     if _os.path.exists(outp):
                         _os.unlink(outp)        # stale partial output
                     env = dict(env0)
                     env.update(subprocess_fault_env("polish.worker"))
-                    r = subprocess.run(
-                        [_sys.executable, "-m",
-                         "parmmg_tpu.parallel._polish_worker", inp,
-                         outp],
-                        stderr=subprocess.PIPE, text=True, env=env)
+                    try:
+                        r = subprocess.run(
+                            [_sys.executable, "-m",
+                             "parmmg_tpu.parallel._polish_worker", inp,
+                             outp],
+                            stderr=subprocess.PIPE, text=True, env=env,
+                            timeout=wdl or None)
+                    except subprocess.TimeoutExpired as te:
+                        # run() already killed the worker; drop any
+                        # partial output so no retry (or a later code
+                        # path) can ever load a half-written npz
+                        if _os.path.exists(outp):
+                            _os.unlink(outp)
+                        record_timeout("polish.worker", wdl)
+                        raise WatchdogTimeout("polish.worker",
+                                              wdl) from te
                     if r.returncode != 0:
                         raise WorkerExitError("polish.worker",
                                               r.returncode, r.stderr)
@@ -827,6 +871,23 @@ def grouped_adapt(mesh: Mesh, met, target_size: int, niter: int = 3,
             otrace.event("ckpt.resumed", tag=ckpt_tag, it=it0, path=path)
             otrace.log(1, f"  resume: loaded {path}; restarting at "
                           f"outer pass {it0}", err=True)
+            # crash-loop breaker: resuming into the SAME pass more
+            # than PARMMG_RESUME_MAX times means that pass
+            # deterministically kills the run — skip past it and hand
+            # the caller the last conforming checkpointed state (the
+            # bounded-time contract; the driver's merged polish /
+            # repair tail still runs on it).  The mh_allgather-style
+            # rung for this site is the merged_polish-grade skip:
+            # record it on the ladder so the run's failure story shows
+            # the escalation
+            _, esc = ckpt.crash_loop(ckpt_tag, fp, it0)
+            if esc:
+                from ..resilience.recover import ladder_step
+                ladder_step("lowfailure", site="ckpt.resume",
+                            detail=f"crash loop at pass {it0}: "
+                                   "returning last conforming "
+                                   "checkpoint")
+                return mesh, met
     for it in range(it0, max(1, niter)):
         # profiler capture window (PARMMG_PROFILE_DIR over the
         # PARMMG_PROFILE_PASS outer-pass range — obs/trace.py)

@@ -131,7 +131,13 @@ def hot_path():
     ``pull_host`` performs inside it is counted on
     ``mh.hot_allgather_bytes`` (gate-asserted zero) and raises under
     PARMMG_MH_STRICT.  The per-iteration body of
-    ``distributed_adapt_multi`` runs inside one."""
+    ``distributed_adapt_multi`` runs inside one.  Entering a hot
+    section also beats this rank's heartbeat file (throttled by
+    PARMMG_HEARTBEAT_S) so the pod supervisor's lease
+    (scripts/multihost_run.py --lease) sees liveness exactly where
+    wedging matters."""
+    from ..resilience.watchdog import beat
+    beat()
     _HOT_DEPTH[0] += 1
     try:
         yield

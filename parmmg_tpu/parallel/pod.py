@@ -180,17 +180,35 @@ def gather_band(*arrays, what: str = ""):
     faultpoint; ``retry_call`` re-attempts under PARMMG_RETRY_*, and
     exhaustion falls back to the metered ``pull_host`` escape hatch
     (ladder step ``mh_allgather``) — bit-identical values, counted
-    bytes, never a silent divergence."""
+    bytes, never a silent divergence.  Hang semantics: each call beats
+    the pod heartbeat (the supervisor's lease cadence), and on the
+    SINGLE-process form a ``PARMMG_DEADLINE_EXCHANGE_S`` watchdog
+    bounds each attempt (a wedged exchange raises ``WatchdogTimeout``
+    into the same retry ladder).  Cross-process the deadline stays
+    OFF by design: a watchdog retry would re-enter the collective out
+    of step with ranks still parked inside it — there the heartbeat
+    lease + kill-the-pack supervisor IS the hang ladder
+    (scripts/multihost_run.py)."""
     from ..resilience.faults import faultpoint
     from ..resilience.recover import (RetryBudgetExhausted, ladder_step,
                                       retry_call)
+    from ..resilience.watchdog import (beat, deadline_knob,
+                                       run_with_deadline)
+
+    ctx0 = current()
+    multi = ctx0 is not None and ctx0.multi()
+    xdl = 0.0 if multi else deadline_knob("PARMMG_DEADLINE_EXCHANGE_S")
 
     def attempt():
+        beat()
         faultpoint("multihost.exchange", key=what or None)
         return _exchange(arrays)
 
     try:
-        out = retry_call(attempt, site="multihost.exchange")
+        out = retry_call(
+            lambda: run_with_deadline(attempt, xdl,
+                                      "multihost.exchange"),
+            site="multihost.exchange")
     except RetryBudgetExhausted as e:
         ctx = current()
         if ctx is not None and ctx.multi():

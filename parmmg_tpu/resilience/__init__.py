@@ -27,7 +27,20 @@ timeout expiry) into one explicit, injectable, gated subsystem:
   shard snapshot of the pre-merge stacked state — the reference's
   ``-distributed-output`` checkpoint role.  ``cli.py -resume`` and
   ``scripts/scale_big.py --resume`` restart a killed run from the last
-  completed pass, bit-identical to an uninterrupted run.
+  completed pass, bit-identical to an uninterrupted run.  The
+  crash-loop breaker (``crash_loop``, ``PARMMG_RESUME_MAX``) bounds
+  the resume ladder itself: a pass that deterministically kills its
+  worker is escalated past instead of resumed forever;
+- :mod:`~parmmg_tpu.resilience.watchdog` — the HANG mirror of the
+  fault registry: deadline watchdogs (``Deadline`` /
+  ``run_with_deadline``, knobs ``PARMMG_DEADLINE_*``) convert a
+  wedged dispatch/exchange/subprocess/serve-step into a
+  ``WatchdogTimeout`` that enters ``retry_call`` like any injected
+  fault, and per-rank heartbeat leases (``beat`` / ``stale_ranks``,
+  ``PARMMG_HEARTBEAT_*``) let the pod supervisor treat a stalled
+  worker like a crashed one (kill the pack, relaunch with resume).
+  Provoked on demand via the ``hang=S`` fault action; soaked by
+  ``scripts/chaos_soak.py``.
 
 Everything here is host-side bookkeeping: no jax import at module
 scope, zero new compile families on the fault-free path (gated by
@@ -36,3 +49,5 @@ scope, zero new compile families on the fault-free path (gated by
 from .faults import FAULTS, fault_trigger, faultpoint        # noqa: F401
 from .recover import (LADDER, RetryBudgetExhausted,          # noqa: F401
                       ladder_step, retry_call)
+from .watchdog import (Deadline, WatchdogTimeout,            # noqa: F401
+                       run_with_deadline)

@@ -32,6 +32,7 @@ continues unprotected rather than dying.
 """
 from __future__ import annotations
 
+import json
 import os
 import re
 
@@ -40,9 +41,9 @@ import numpy as np
 from .faults import faultpoint
 
 __all__ = [
-    "ckpt_config", "ckpt_due", "latest_dist_checkpoint",
+    "ckpt_config", "ckpt_due", "crash_loop", "latest_dist_checkpoint",
     "latest_pass_checkpoint", "load_dist_checkpoint",
-    "load_pass_checkpoint", "save_dist_checkpoint",
+    "load_pass_checkpoint", "resume_max", "save_dist_checkpoint",
     "save_pass_checkpoint", "snapshot_stacked",
 ]
 
@@ -65,6 +66,78 @@ def ckpt_due(it: int) -> bool:
 
 def _ckpt_path(d: str, tag: str, it: int) -> str:
     return os.path.join(d, f"{tag}.pass{it}.npz")
+
+
+# ---------------------------------------------------------------------------
+# crash-loop breaker
+# ---------------------------------------------------------------------------
+def resume_max() -> int:
+    """Resume attempts into the SAME (fingerprint, pass) before the
+    breaker escalates past the failing rung (PARMMG_RESUME_MAX)."""
+    try:
+        return max(1, int(os.environ.get("PARMMG_RESUME_MAX", "3")
+                          or 3))
+    except ValueError:
+        return 3
+
+
+def crash_loop(tag: str, fingerprint: str | None, it: int,
+               write: bool = True) -> tuple[int, bool]:
+    """The crash-loop breaker decision, taken at resume time.
+
+    Checkpoint/resume made a crash survivable; it also made a
+    DETERMINISTIC crash eternal — a pass that reliably kills its
+    worker resumes into the identical state and kills it again, and
+    the supervisor relaunch loop never terminates (the unbounded-time
+    failure the LOWFAILURE contract forbids).  This records a
+    per-(fingerprint, pass) resume-attempt count in a small JSON file
+    next to the checkpoints and returns ``(attempts, escalate)``:
+    ``escalate`` turns True on the attempt AFTER ``resume_max()`` is
+    reached, the caller's signal to skip past the failing pass (the
+    last conforming checkpointed state IS the bounded-time answer —
+    the driver's merged-polish/LOWFAILURE tail still runs on it).
+
+    Escalation is emitted as a ``resilience.crash_loop`` event + a
+    ``resilience.crash_loops`` counter.  ``write=False`` computes the
+    decision without persisting the bump (non-zero pod ranks: only
+    rank 0 writes to the shared checkpoint dir, and the ranks agree
+    on the final decision collectively — parallel/dist.py).  Like all
+    checkpoint bookkeeping, IO failure here is absorbed, never
+    raised."""
+    d, _ = ckpt_config()
+    key = f"{fingerprint or ''}:{int(it)}"
+    counts: dict = {}
+    path = os.path.join(d, f"{tag}.resume.json") if d else ""
+    if path:
+        try:
+            with open(path) as fh:
+                counts = dict(json.load(fh))
+        except Exception:
+            counts = {}
+    n = int(counts.get(key, 0)) + 1
+    if path and write:
+        counts[key] = n
+        try:
+            tmp = path + ".tmp"
+            with open(tmp, "w") as fh:
+                json.dump(counts, fh)
+            os.replace(tmp, path)
+        except OSError:
+            pass
+    mx = resume_max()
+    esc = n > mx
+    if esc:
+        from ..obs import trace as otrace
+        from ..obs.metrics import REGISTRY
+        REGISTRY.counter("resilience.crash_loops").inc()
+        otrace.event("resilience.crash_loop", tag=tag, it=int(it),
+                     attempts=n, max=mx)
+        otrace.log(1, f"  ## resilience: crash loop — pass {it} "
+                      f"resumed {n}x (PARMMG_RESUME_MAX={mx}); "
+                      "escalating past the failing pass: the last "
+                      "conforming checkpointed state is the "
+                      "bounded-time answer.", err=True)
+    return n, esc
 
 
 def run_fingerprint(mesh, met, *knobs) -> str:

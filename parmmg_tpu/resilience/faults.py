@@ -20,7 +20,13 @@ rules (all must pass for the site to fire):
   sequence reproducible; default seed 0);
 - ``key=S``     — fire only on hits whose ``key`` argument equals S
   (e.g. a specific serve tenant); non-matching hits do not advance the
-  site's hit counter.
+  site's hit counter;
+- ``hang=S``    — ACTION modifier: when the rule fires, the site
+  sleeps S seconds and then RETURNS instead of raising — the testable
+  stand-in for a wedged collective/worker (the failure mode deadline
+  watchdogs and heartbeat leases exist for, resilience/watchdog.py).
+  Composes with the triggers above; for the subprocess site the
+  worker sleeps pre-jax instead of exiting.
 
 Exception fidelity: :func:`faultpoint` raises the site's REAL failure
 shape — ``XlaRuntimeError`` for device-dispatch sites, ``OSError`` for
@@ -39,6 +45,7 @@ import dataclasses
 import os
 import random
 import threading
+import time
 
 __all__ = [
     "FAULTS", "FaultRegistry", "FaultRule", "SITES", "fault_trigger",
@@ -72,6 +79,7 @@ class FaultRule:
     p: float | None = None       # fire with probability p per hit
     seed: int = 0
     key: str | None = None       # fire only when the hit key matches
+    hang: float | None = None    # ACTION: sleep S then return, no raise
 
     def __post_init__(self):
         self._rng = random.Random(self.seed)
@@ -118,12 +126,16 @@ def parse_fault_spec(spec: str) -> dict:
                 kw["seed"] = int(tok[5:])
             elif tok.startswith("key="):
                 kw["key"] = tok[4:]
+            elif tok.startswith("hang="):
+                kw["hang"] = float(tok[5:])
             else:
                 raise ValueError(
                     f"unparseable fault trigger {tok!r} in {part!r}")
         for f in ("nth", "every"):
             if kw.get(f) is not None and kw[f] < 1:
                 raise ValueError(f"{f} must be >= 1 in {part!r}")
+        if kw.get("hang") is not None and kw["hang"] <= 0:
+            raise ValueError(f"hang must be > 0 seconds in {part!r}")
         rules[site] = FaultRule(site=site, **kw)
     return rules
 
@@ -155,12 +167,21 @@ class FaultRegistry:
         with self._lock:
             return bool(self._resolve())
 
-    def should_fire(self, site: str, key: str | None = None) -> bool:
+    def fired_rule(self, site: str,
+                   key: str | None = None) -> FaultRule | None:
+        """The armed rule for ``site`` when it fires on this hit, else
+        None.  Callers needing the ACTION (raise vs ``hang``) use this;
+        :meth:`should_fire` stays the boolean form."""
         with self._lock:
             rule = self._resolve().get(site)
             if rule is None:
-                return False
-            return rule.fires(None if key is None else str(key))
+                return None
+            if rule.fires(None if key is None else str(key)):
+                return rule
+            return None
+
+    def should_fire(self, site: str, key: str | None = None) -> bool:
+        return self.fired_rule(site, key) is not None
 
 
 FAULTS = FaultRegistry()
@@ -183,38 +204,61 @@ def _site_exception(site: str, key: str | None):
         return RuntimeError(msg)
 
 
-def _record(site: str, key: str | None) -> None:
+def _record(site: str, key: str | None,
+            hang: float | None = None) -> None:
     from ..obs import trace as otrace
     from ..obs.metrics import REGISTRY
     REGISTRY.counter("resilience.faults_injected").inc()
     otrace.event("fault.injected", site=site,
-                 **({} if key is None else {"key": str(key)}))
+                 **({} if key is None else {"key": str(key)}),
+                 **({} if hang is None else {"hang_s": float(hang)}))
 
 
 def faultpoint(site: str, key: str | None = None) -> None:
     """Raise the site's real exception type when armed and firing.
-    Free when PARMMG_FAULT is unset (one dict lookup)."""
-    if FAULTS.should_fire(site, key):
-        _record(site, key)
-        raise _site_exception(site, key)
+    Free when PARMMG_FAULT is unset (one dict lookup).  A firing rule
+    with ``hang=S`` sleeps S seconds and returns instead — the wedge,
+    not the crash: nothing raises, and only a deadline watchdog or
+    heartbeat lease (resilience/watchdog.py) can notice."""
+    rule = FAULTS.fired_rule(site, key)
+    if rule is None:
+        return
+    if rule.hang is not None:
+        _record(site, key, hang=rule.hang)
+        time.sleep(rule.hang)
+        return
+    _record(site, key)
+    raise _site_exception(site, key)
 
 
 def fault_trigger(site: str, key: str | None = None) -> bool:
     """Flag-style sites (the real failure is a condition, not an
     exception — e.g. the analysis KS-overflow fallback): True when the
-    armed fault fires, so the caller takes its real degraded branch."""
-    if FAULTS.should_fire(site, key):
-        _record(site, key)
-        return True
-    return False
+    armed fault fires, so the caller takes its real degraded branch.
+    A ``hang=S`` rule sleeps and returns False — a wedge delays the
+    site, it does not flip its condition."""
+    rule = FAULTS.fired_rule(site, key)
+    if rule is None:
+        return False
+    if rule.hang is not None:
+        _record(site, key, hang=rule.hang)
+        time.sleep(rule.hang)
+        return False
+    _record(site, key)
+    return True
 
 
 def subprocess_fault_env(site: str) -> dict:
     """Firing decision for subprocess sites, evaluated IN THE PARENT
     (so nth/every counting survives across worker invocations): returns
     the env overlay to merge into the worker's environment — the worker
-    exits non-zero when it sees ``PARMMG_FAULT_FORCE`` naming it."""
-    if FAULTS.should_fire(site):
-        _record(site, None)
-        return {FORCE_ENV: site}
-    return {}
+    exits non-zero when it sees ``PARMMG_FAULT_FORCE`` naming it, or
+    sleeps pre-jax on the ``site:hang=S`` form (the wedged-worker
+    drill: the parent's subprocess timeout is what must catch it)."""
+    rule = FAULTS.fired_rule(site)
+    if rule is None:
+        return {}
+    _record(site, None, hang=rule.hang)
+    if rule.hang is not None:
+        return {FORCE_ENV: f"{site}:hang={rule.hang:g}"}
+    return {FORCE_ENV: site}

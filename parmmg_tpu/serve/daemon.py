@@ -36,6 +36,13 @@ slot scrubbed + recycled) while cohort-mates keep their bit-identical
 results and the daemon keeps serving (gated by run_tests.sh --chaos).
 The serving loop composes with the PR 9 ladder unchanged (slot
 retries, slot-fault quarantine).
+
+Hang semantics: each serving-loop step runs under an optional
+``PARMMG_DEADLINE_SERVE_S`` watchdog (resilience/watchdog.py).  The
+first-use grace (``PARMMG_DEADLINE_GRACE_S``) distinguishes the
+legitimate cold-compile first step from a wedged loop; on expiry the
+daemon flips ``/healthz`` to not-ok with ``wedged: true`` and waits
+the stuck step out instead of piling new steps behind the held lock.
 """
 from __future__ import annotations
 
@@ -105,6 +112,7 @@ class PoolDaemon:
             else _env_int("PARMMG_SERVE_PORT", 8077)
         self.idle_sleep_s = float(idle_sleep_s)
         self.paused = bool(start_paused)
+        self._wedged = False
         self._lock = threading.RLock()
         self._stop = threading.Event()
         self._httpd = None
@@ -138,13 +146,50 @@ class PoolDaemon:
     def _loop(self) -> None:
         from ..obs import trace as otrace
         from ..obs.metrics import REGISTRY
+        from ..resilience.watchdog import (WatchdogTimeout,
+                                           deadline_knob,
+                                           run_with_deadline)
+
+        def step():
+            # the lock is taken INSIDE the guarded fn so a wedged step
+            # is observable: the watchdog thread owns the RLock for the
+            # step's whole (possibly unbounded) duration, /healthz
+            # stays lock-free by design
+            with self._lock:
+                return self.driver.service_once()
+
         while not self._stop.is_set():
             if self.paused:
                 self._stop.wait(self.idle_sleep_s)
                 continue
+            # re-read each iteration: ops can arm/disarm the step
+            # deadline on a live daemon.  run_with_deadline's first-use
+            # grace (PARMMG_DEADLINE_GRACE_S) absorbs the legitimate
+            # cold-compile first step; after that, a step exceeding the
+            # budget is a WEDGED loop, not a slow one.
+            dl = deadline_knob("PARMMG_DEADLINE_SERVE_S")
             try:
-                with self._lock:
-                    st = self.driver.service_once()
+                st = run_with_deadline(step, dl, "serve.slot_step")
+            except WatchdogTimeout as e:
+                # the abandoned step thread still holds the RLock:
+                # spawning more steps would just pile up behind it.
+                # Mark the daemon wedged (healthz flips not-ok so a
+                # supervisor can restart it) and wait the thread out —
+                # if it ever finishes, serving resumes.
+                REGISTRY.counter("serve.step_timeouts").inc()
+                otrace.event("serve.step_timeout",
+                             seconds=float(e.seconds))
+                otrace.log(0, f"serve daemon: serving step exceeded "
+                              f"{e.seconds:g}s deadline — wedged "
+                              "(healthz not-ok) until it returns",
+                           err=True)
+                self._wedged = True
+                th = getattr(e, "thread", None)
+                while th is not None and th.is_alive() \
+                        and not self._stop.is_set():
+                    self._stop.wait(max(self.idle_sleep_s, 0.1))
+                self._wedged = False
+                continue
             except Exception as e:
                 # the loop is the service: an escaped iteration error
                 # (a degenerate merge, an actuation failure) must not
@@ -267,9 +312,11 @@ class PoolDaemon:
             # is an operator choice, not a death)
             loop_alive = bool(len(self._threads) > 1
                               and self._threads[1].is_alive())
-            out = {"ok": bool(self.paused or loop_alive),
+            out = {"ok": bool((self.paused or loop_alive)
+                              and not self._wedged),
                    "paused": self.paused,
                    "loop_alive": loop_alive,
+                   "wedged": self._wedged,
                    "steps": d.pool.steps,
                    "active": len(d.pool.active_tenants()),
                    "queue": len(d.queue),

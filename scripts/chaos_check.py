@@ -455,6 +455,78 @@ def main() -> int:
     check(delta(c0, "resilience.mh_allgather") >= 1,
           "resilience.mh_allgather counter bumped")
 
+    # ---- 9. hang drills: deadline watchdogs convert wedges to retries --
+    # (PR 15: the hang=S fault action sleeps inside the site instead of
+    # raising; only an armed PARMMG_DEADLINE_* watchdog can turn that
+    # into the WatchdogTimeout the existing ladder already handles)
+    print("--- chaos gate: hang=S -> deadline watchdog -> ladder")
+    c0 = counters()
+    mark = ring_mark()
+    with env(PARMMG_FAULT="dispatch.chunk:hang=3;nth-1",
+             PARMMG_RETRY_MAX="2", PARMMG_DEADLINE_DISPATCH_S="0.5",
+             PARMMG_DEADLINE_GRACE_S="0"):
+        got = run_grouped()
+    check(got == base,
+          "wedged chunk dispatch recovered bit-for-bit (watchdog -> "
+          "retry rung)")
+    check(delta(c0, "resilience.watchdog_timeouts") >= 1,
+          "watchdog_timeouts counter bumped")
+    check("retry" in ladder_steps_since(mark),
+          "watchdog expiry entered the retry ladder")
+    # wedged polish WORKER: the parent's subprocess timeout must kill
+    # it (PARMMG_POLISH_TIMEOUT_S), unlink the partial output and ride
+    # the same merged_polish degrade as a crashed worker
+    c0 = counters()
+    mark = ring_mark()
+    pre_leaks = {d for d in os.listdir(tempfile.gettempdir())
+                 if d.startswith("parmmg_polish_")}
+    with env(PARMMG_FAULT="polish.worker:hang=30",
+             PARMMG_RETRY_MAX="1", PARMMG_POLISH_TIMEOUT_S="2",
+             PARMMG_POLISH_SUBPROC="1"):
+        got = run_pass(True)
+    check(got == ref,
+          "wedged polish worker killed + degraded to the polish-less "
+          "pass bit-for-bit")
+    check(delta(c0, "resilience.watchdog_timeouts") >= 1,
+          "polish timeout recorded as watchdog expiry")
+    check("merged_polish" in ladder_steps_since(mark),
+          "merged_polish ladder step after the killed worker")
+    leaks = [d for d in os.listdir(tempfile.gettempdir())
+             if d.startswith("parmmg_polish_") and d not in pre_leaks]
+    check(not leaks, f"no leaked polish staging after the kill ({leaks})")
+    # wedged single-process band exchange -> watchdog -> retry rung
+    c0 = counters()
+    with env(PARMMG_FAULT="multihost.exchange:hang=3;nth-1",
+             PARMMG_RETRY_MAX="2", PARMMG_DEADLINE_EXCHANGE_S="0.5",
+             PARMMG_DEADLINE_GRACE_S="0"):
+        got = run_dist()
+    check(got == base_d,
+          "wedged band exchange recovered bit-for-bit (watchdog -> "
+          "retry rung)")
+    check(delta(c0, "resilience.watchdog_timeouts") >= 1,
+          "exchange watchdog expiry recorded")
+
+    # ---- 10. seeded soak smoke (scripts/chaos_soak.py, in-process) -----
+    # fixed seed, 3 runs: proves the harness end-to-end on the warm
+    # programs this gate already compiled; the full campaign is the
+    # standalone `python scripts/chaos_soak.py`
+    print("--- chaos gate: seeded soak smoke (3 runs)")
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "chaos_soak", os.path.join(os.path.dirname(
+            os.path.abspath(__file__)), "chaos_soak.py"))
+    soak = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(soak)
+    sched = soak.build_schedule(11, 3)
+    check(sched == soak.build_schedule(11, 3)
+          and sched != soak.build_schedule(12, 3),
+          "soak schedule is a pure function of the seed")
+    doc = soak.run_campaign(11, 3, say=lambda m: print(f"  {m}"))
+    check(doc["extra"]["failed"] == 0,
+          f"soak smoke clean ({doc['extra']['failures']})")
+    check(doc["kind"] == "SOAK" and doc["extra"]["runs"] == 3,
+          "soak artifact well-formed")
+
     # ---- verdict -------------------------------------------------------
     if FAILS:
         print(f"\nchaos gate FAILED ({len(FAILS)} checks):",

@@ -16,10 +16,14 @@ devices, gloo collectives) through scripts/multihost_run.py and FAILS
    collectives only, the metered ``pull_host`` escape hatch untouched
    (runtime mirror of lint rule R7);
 
-plus the pod failure-mode drill: a worker killed mid-run by an armed
+plus two pod failure-mode drills: a worker killed mid-run by an armed
 ``multihost.exchange`` fault (pass 1, after the pass-0 checkpoint) is
 the EXPECTED failure mode — the parent relaunches with resume and the
-finished mesh must be bit-identical to the uninterrupted run.
+finished mesh must be bit-identical to the uninterrupted run; and the
+same drill with the worker WEDGED instead of killed (``hang=600``
+fault action) — the heartbeat lease (``--lease``) must detect the
+stalled rank, kill the pack and drive the identical resume path to
+the identical bits.
 
 First invocation pays the scenario's compiles into the repo-local
 ``.jax_cache_mh`` (warm phase + the 1-process reference); repeat
@@ -116,6 +120,40 @@ def main() -> int:
           "resumed run finished bit-identical to the uninterrupted "
           "run")
 
+    # ---- 5. wedged-worker drill: heartbeat lease -> kill -> resume -----
+    print("--- multihost gate: wedged worker -> lease expiry -> resume "
+          "drill")
+    ck2 = os.path.join(td, "ckpt_hang")
+    os.makedirs(ck2, exist_ok=True)
+    # worker 1 HANGS (hang=600: sleeps, never raises, never exits) at
+    # its pass-1 extend exchange — after the pass-0 checkpoint and
+    # after both ranks' first heartbeat.  Only the lease can end this
+    # run inside the gate budget: the parent must see the stale
+    # heartbeat, kill the pack (rc 9) and relaunch with resume.
+    # Lease sizing: it must exceed the pack's longest LEGITIMATE
+    # beat-free window — on a single shared core the whole pack stops
+    # beating while any rank recompiles a residual program (the peers
+    # block in the next collective), ~25-30s here; 60s is 2x margin
+    # and still far under the 600s wedge (gloo happily waits minutes
+    # inside a collective, measured — the blocked healthy rank does
+    # not time out first).
+    doc3 = run(["--no-warm", "--ckpt", ck2, "--lease", "60",
+                "--fault",
+                "1:multihost.exchange:key=extend;nth-2;hang=600"],
+               env_over={"PARMMG_HEARTBEAT_S": "0.5"})
+    ex3 = doc3["extra"]
+    check(bool(ex3.get("stale_heartbeat")),
+          f"heartbeat lease expired for the wedged pack "
+          f"(stale ranks {ex3.get('stale_heartbeat')})")
+    check(ex3.get("crashed_rc") == 9,
+          f"lease expiry killed the pack with the stale-lease rc "
+          f"(rc {ex3.get('crashed_rc')})")
+    check(ex3.get("resumed") is True,
+          "wedged run resumed from the pass-0 checkpoint")
+    check(ex3.get("hash") == base_hash,
+          "post-hang resumed run finished bit-identical to the "
+          "uninterrupted run")
+
     if FAILS:
         print(f"\nmultihost gate FAILED ({len(FAILS)} checks):",
               file=sys.stderr)
@@ -123,8 +161,8 @@ def main() -> int:
             print(f"  {f}", file=sys.stderr)
         return 1
     print("\nmultihost OK: 2-process parity, warm-cache ~zero worker "
-          "compiles, allgather-free hot path, crash->resume "
-          "bit-identity")
+          "compiles, allgather-free hot path, crash->resume and "
+          "wedge->lease->resume bit-identity")
     return 0
 
 

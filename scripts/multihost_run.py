@@ -32,9 +32,18 @@ collectives) and, when ``--ckpt`` is set, relaunches the run with
 checkpoint and must finish bit-identical (`scripts/multihost_check.py`
 asserts it).
 
+A wedged worker is the same failure without the exit code: under
+``--lease S`` each worker beats a per-rank heartbeat file (inside
+``multihost.hot_path`` / ``pod.gather_band``; knob PARMMG_HEARTBEAT_S)
+and the parent holds a lease per worker — a rank that has beaten once
+and then goes silent for S seconds gets the whole pack killed (rc 9)
+and the same checkpoint/resume relaunch.  A rank that never beat is
+never stale: startup + cold compile are covered by ``--timeout``.
+
 Usage: python scripts/multihost_run.py [--np 2] [--devices 4] [--n 4]
            [--niter 2] [--cycles 4] [--parity] [--no-warm]
-           [--cache DIR] [--ckpt DIR] [--fault PID:SPEC] [--out PATH]
+           [--cache DIR] [--ckpt DIR] [--lease S]
+           [--fault PID:SPEC] [--out PATH]
 Prints ONE canonical artifact JSON line (stdout) from the parent.
 
 Kept out of the default test matrix: ``run_tests.sh --multihost``
@@ -181,13 +190,21 @@ def worker() -> None:
 # ---------------------------------------------------------------------------
 def launch(args, np_proc: int, tmpdir: str, resume: bool = False,
            fault: tuple[int, str] | None = None,
-           tag: str = "run") -> tuple[int, bytes, list]:
+           tag: str = "run") -> tuple[int, bytes, list, dict]:
     """One phase: spawn np_proc workers, kill the pack on the first
-    non-zero exit (a dead rank stalls the survivors' collectives),
-    return (rc, proc-0 stdout, worker sidecars)."""
+    non-zero exit (a dead rank stalls the survivors' collectives) OR
+    on an expired heartbeat lease (--lease: a WEDGED rank stalls them
+    just the same, without the courtesy of exiting), return (rc,
+    proc-0 stdout, worker sidecars, supervision info)."""
+    # stdlib-only module (resilience/watchdog.py): safe in this parent
+    # process, which must never import jax
+    from parmmg_tpu.resilience.watchdog import stale_ranks
     port = free_port()
     procs = []
     sidecars = []
+    info: dict = {}
+    lease = float(getattr(args, "lease", 0) or 0)
+    hb_dir = os.path.join(tmpdir, f"hb.{tag}")
     for pid in range(np_proc):
         side = os.path.join(tmpdir, f"{tag}.w{pid}.json")
         sidecars.append(side)
@@ -221,6 +238,10 @@ def launch(args, np_proc: int, tmpdir: str, resume: bool = False,
             env["PARMMG_CKPT_DIR"] = args.ckpt
         if resume:
             env["MH_RESUME"] = "1"
+        if lease > 0:
+            # arm the per-rank heartbeat files this supervisor's lease
+            # reads (workers beat inside hot_path / gather_band)
+            env["PARMMG_MH_HEARTBEAT_DIR"] = hb_dir
         if fault is not None and fault[0] == pid:
             env["PARMMG_FAULT"] = fault[1]
         procs.append(subprocess.Popen(
@@ -240,6 +261,20 @@ def launch(args, np_proc: int, tmpdir: str, resume: bool = False,
             live.discard(pid)
             if r != 0:
                 rc = rc or r
+                failed = True
+        if not failed and lease > 0 and live:
+            stale = stale_ranks(hb_dir, lease, sorted(live))
+            if stale:
+                # a WEDGED rank is a crashed rank that forgot to exit:
+                # its lease expired (no beat for --lease seconds after
+                # its FIRST beat), so treat it exactly like a non-zero
+                # exit — kill the pack, let the checkpoint/resume
+                # ladder recover
+                print(f"multihost_run: heartbeat lease expired for "
+                      f"rank(s) {stale} ({tag}); killing the pack",
+                      file=sys.stderr)
+                info["stale_heartbeat"] = stale
+                rc = rc or 9
                 failed = True
         if failed and live:
             # a dead rank stalls the survivors' collectives: kill the
@@ -263,8 +298,9 @@ def launch(args, np_proc: int, tmpdir: str, resume: bool = False,
             p.wait(timeout=10)
         except subprocess.TimeoutExpired:
             p.kill()
-    return rc, out0, [json.load(open(s)) if os.path.exists(s) else None
-                      for s in sidecars]
+    return (rc, out0,
+            [json.load(open(s)) if os.path.exists(s) else None
+             for s in sidecars], info)
 
 
 def warm_marker(args) -> str:
@@ -288,6 +324,12 @@ def main() -> None:
     ap.add_argument("--ckpt", default="",
                     help="per-pass checkpoint dir (arms resume-on-"
                          "crash)")
+    ap.add_argument("--lease", type=float,
+                    default=float(os.environ.get(
+                        "PARMMG_HEARTBEAT_LEASE_S", "0") or 0),
+                    help="heartbeat lease seconds: kill the pack when "
+                         "a worker that already beat stops beating "
+                         "this long (0 = off)")
     ap.add_argument("--parity", action="store_true",
                     help="run the 1-process reference for parity_ok")
     ap.add_argument("--no-warm", action="store_true")
@@ -309,7 +351,7 @@ def main() -> None:
     ref_hash = None
     if args.parity:
         t0 = time.time()
-        rc, out0, sides = launch(args, 1, tmpdir, tag="ref")
+        rc, out0, sides, _info = launch(args, 1, tmpdir, tag="ref")
         if rc != 0:
             print("multihost_run: reference run failed", file=sys.stderr)
             sys.exit(rc)
@@ -322,7 +364,7 @@ def main() -> None:
     marker = warm_marker(args)
     if not args.no_warm and not os.path.exists(marker):
         t0 = time.time()
-        rc, _out, _s = launch(args, args.np, tmpdir, tag="warm")
+        rc, _out, _s, _info = launch(args, args.np, tmpdir, tag="warm")
         if rc != 0:
             print("multihost_run: warm run failed", file=sys.stderr)
             sys.exit(rc)
@@ -332,15 +374,17 @@ def main() -> None:
 
     # ---- phase 3: the timed pod run ------------------------------------
     t0 = time.time()
-    rc, out0, sides = launch(args, args.np, tmpdir, fault=fault,
-                             tag="timed")
+    rc, out0, sides, info = launch(args, args.np, tmpdir, fault=fault,
+                                   tag="timed")
+    if info.get("stale_heartbeat"):
+        extra_parent["stale_heartbeat"] = info["stale_heartbeat"]
     if rc != 0 and args.ckpt:
         # worker crash drill: the EXPECTED pod failure mode — relaunch
         # from the newest per-pass checkpoint (fault disarmed: the
-        # crash consumed it)
+        # crash — or the lease-expiry pack kill — consumed it)
         extra_parent["crashed_rc"] = rc
-        rc, out0, sides = launch(args, args.np, tmpdir, resume=True,
-                                 tag="resumed")
+        rc, out0, sides, _info = launch(args, args.np, tmpdir,
+                                        resume=True, tag="resumed")
     if rc != 0:
         print("multihost_run: FAILED", file=sys.stderr)
         sys.exit(rc)

@@ -57,9 +57,15 @@ fi
 # land on its documented escalation-ladder step: recovered bit-for-bit
 # (transient dispatch fault, checkpoint/resume) or degraded with a
 # conforming mesh (retry exhaustion -> LOWFAILURE, worker death ->
-# merged polish, serve quarantine with cohort parity).  The zero-fault
-# run with the resilience wiring active must be bit-neutral and add
-# ZERO new groups.* compile families.
+# merged polish, serve quarantine with cohort parity).  Hang drills
+# (hang=S fault action): a wedged chunk dispatch / band exchange is
+# converted by its PARMMG_DEADLINE_* watchdog into the same retry
+# ladder, and a wedged polish worker is killed by
+# PARMMG_POLISH_TIMEOUT_S into the merged_polish degrade — all
+# bit-for-bit.  Ends with a 3-run fixed-seed smoke of the seeded
+# chaos-soak harness (scripts/chaos_soak.py; the full campaign is
+# standalone).  The zero-fault run with the resilience wiring active
+# must be bit-neutral and add ZERO new groups.* compile families.
 if [ "${1:-}" = "--chaos" ]; then
     exec env JAX_PLATFORMS=cpu python scripts/chaos_check.py
 fi
@@ -78,8 +84,11 @@ fi
 # path, every worker must pay ~zero compiles through the shared warm
 # cache, the hot path must perform ZERO process_allgather bytes
 # (mh.hot_allgather_bytes), and a worker killed mid-run must resume
-# from its per-pass checkpoint bit-identically.  First invocation
-# warms the repo-local .jax_cache_mh; repeats run warm.
+# from its per-pass checkpoint bit-identically — as must a worker
+# WEDGED mid-run (hang=600 fault action): its heartbeat lease
+# (--lease) expires, the supervisor kills the pack and the resumed
+# run lands on the same bits.  First invocation warms the repo-local
+# .jax_cache_mh; repeats run warm.
 if [ "${1:-}" = "--multihost" ]; then
     exec env JAX_PLATFORMS=cpu python scripts/multihost_check.py
 fi
