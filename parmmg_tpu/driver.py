@@ -514,6 +514,11 @@ def interpolate_fields(bg: Mesh, fields: list[np.ndarray], new: Mesh)\
     pts = np.asarray(new.vert)[vm]
     on_bdy = (np.asarray(new.vtag)[vm] & MG_BDY) != 0
     loc = locate_points(bg, jnp.asarray(pts, new.vert.dtype),
+                        # lint: ok(R10) — one-shot solution-transfer
+                        # boundary: the query count IS the compile
+                        # family here, and locate_points retraces per
+                        # point count regardless (host mesh ingest,
+                        # outside the governed adapt loop)
                         jnp.zeros(len(pts), jnp.int32))
     # the surface walk runs on the boundary SUBSET only (the volume pass
     # would feed interior points through the closest-triangle machinery

@@ -1,16 +1,22 @@
-"""Static invariant linter (rules R1-R6).
+"""Static invariant linter (rules R1-R10).
 
 Pure-stdlib ``ast`` checks for the project's load-bearing invariants —
-compile hygiene (R1/R5), the zero-host-pull hot path (R2), obs routing
-(R3), the PARMMG_* knob registry (R4) and static telemetry names (R6)
-— so a violation class the runtime gates (``--ledger``/``--obs``/
-``--chaos``) would need minutes of XLA:CPU compile to catch fails in
-seconds at lint time, before review.  ``scripts/lint_check.py`` is the
-CLI; ``run_tests.sh --lint`` the gate; ``lint_baseline.json`` the
-grandfathered burn-down list.  Importing this package never imports
-jax (enforced by lint_check's own self-check and tests/test_lint.py).
+compile hygiene (R1/R5), the zero-host-pull hot path (R2/R7), obs
+routing (R3), the PARMMG_* knob registry (R4), static telemetry names
+(R6) — plus the flow-sensitive provers built on ``lint.flow``'s
+interprocedural core: SPMD collective alignment (R8), lock discipline
+(R9) and shape-ladder hygiene (R10) — so a violation class the runtime
+gates (``--ledger``/``--obs``/``--chaos``/``--serve``/``--multihost``)
+would need minutes of XLA:CPU compile (or a live 2-process pod) to
+catch fails in seconds at lint time, before review.
+``scripts/lint_check.py`` is the CLI (``--sarif``/``--changed-only``
+for CI and the inner loop); ``run_tests.sh --lint`` the gate;
+``lint_baseline.json`` the grandfathered burn-down list.  Importing
+this package never imports jax (enforced by lint_check's own
+self-check and tests/test_lint.py).
 """
-from . import rules_compile, rules_hostsync, rules_knobs, rules_obs  # noqa: F401,E501  (register rules)
+from . import (rules_compile, rules_hostsync, rules_knobs,  # noqa: F401
+               rules_locks, rules_obs, rules_shapes, rules_spmd)
 from .engine import (RULES, RULE_TITLES, GateResult, LintReport,  # noqa: F401
                      SourceFile, Violation, baseline_payload,
                      collect_files, format_report, gate,

@@ -47,6 +47,13 @@ RULE_TITLES = {
     "R6": "name-schemes (static dotted metric/trace/fault names)",
     "R7": "mh-allgather (no pull_host/process_allgather on the pod "
           "hot path; route band tables through pod.gather_band)",
+    "R8": "spmd-alignment (no collective control-dependent on "
+          "rank-divergent state; mh_uniform/allgather-agreed only)",
+    "R9": "lock-discipline (acyclic lock order; no collective/"
+          "subprocess dispatch under a held lock; guarded "
+          "cross-thread fields)",
+    "R10": "shape-ladder (device-array shapes from measured ints "
+           "must pass bucket()/pad_comm_tables)",
     "SUPP": "suppression hygiene (reason required)",
 }
 
@@ -110,6 +117,7 @@ class SourceFile:
             self.parse_error = f"{rel}:{e.lineno}: {e.msg}"
         self.suppressions: dict[int, list[Suppression]] = {}
         self.bad_suppressions: list[Violation] = []
+        self._def_index: list | None = None
         self._scan_suppressions()
 
     def _scan_suppressions(self) -> None:
@@ -158,6 +166,32 @@ class SourceFile:
                 if rid in s.rules:
                     return s
         return None
+
+    def def_anchors(self, line: int) -> tuple:
+        """Def + decorator lines of the innermost function enclosing
+        ``line`` — the engine-level anchors that make a def-line
+        ``# lint: ok(...)`` exempt the whole function identically for
+        EVERY rule (not just the ones that pass anchor_lines)."""
+        if self.tree is None:
+            return ()
+        if self._def_index is None:
+            idx = []
+            for node in ast.walk(self.tree):
+                if isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                    anchors = (node.lineno,) + tuple(
+                        d.lineno for d in node.decorator_list)
+                    start = min(anchors)
+                    end = getattr(node, "end_lineno", None) \
+                        or node.lineno
+                    idx.append((start, end, anchors))
+            self._def_index = idx
+        best = None
+        for start, end, anchors in self._def_index:
+            if start <= line <= end and (
+                    best is None or end - start < best[0]):
+                best = (end - start, anchors)
+        return best[1] if best else ()
 
 
 # ---------------------------------------------------------------------------
@@ -372,7 +406,12 @@ def run_lint(root: str | None = None, rules=None,
     kept, supp = [], []
     for v in raw:
         sf = files.get(v.path)
-        s = sf.suppressed(v.rule, v.line, v.anchor_lines) if sf \
+        # rule-provided anchors plus the engine-resolved enclosing-def
+        # lines: a def-line suppression exempts the whole function for
+        # any rule, decorated or not
+        s = sf.suppressed(
+            v.rule, v.line,
+            tuple(v.anchor_lines) + sf.def_anchors(v.line)) if sf \
             else None
         (supp if s else kept).append((v, s) if s else v)
     kept.sort(key=lambda v: (v.path, v.line, v.rule))

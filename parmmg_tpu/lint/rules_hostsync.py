@@ -18,15 +18,21 @@ The graph is name-based and over-approximate on purpose: a false
 positive costs one reasoned suppression or a baseline entry; a false
 negative is a silent O(mesh) pull multiplying under the chip campaigns.
 Functions that ARE the documented host fallback (the KS-overflow
-ladder) carry a def-line suppression — R2 honours a suppression on the
-violating line, the line above, or the enclosing ``def`` line, so one
-annotation exempts a whole fallback function with its reason attached.
+ladder) carry a def-line suppression — the engine honours a
+suppression on the violating line, the line above, or the enclosing
+``def`` line, so one annotation exempts a whole fallback function with
+its reason attached.
+
+The function index, call edges and reachability worklist live in
+``lint.flow`` (the interprocedural core R8-R10 build their summaries
+on); R2/R7 are its original reachability clients.
 """
 from __future__ import annotations
 
 import ast
 
-from .engine import Violation, dotted, rule, walk_scoped
+from . import flow
+from .engine import Violation, dotted, rule
 
 #: reachability roots — the grouped/dist hot paths (PR-4/PR-5 contract)
 ROOTS = (
@@ -63,77 +69,6 @@ def _host_only_arg(arg) -> bool:
     return False
 
 
-def _functions(ctx):
-    """{simple name: [(SourceFile, qualname, node)]} for every def in
-    scope (nested defs included — the dispatch/drain closures are where
-    the pulls live)."""
-    idx: dict[str, list] = {}
-    for sf in ctx.iter(_SCOPE):
-        if sf.tree is None:
-            continue
-        for node, qn, _funcs in walk_scoped(sf.tree):
-            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                idx.setdefault(node.name, []).append((sf, qn, node))
-    return idx
-
-
-def _called_names(fn_node) -> set:
-    """Simple callee names referenced inside a function: direct Name
-    calls, terminal attribute calls (``sched.chunk_plans``), and bare
-    Name references (callbacks passed around, e.g.
-    ``_pipeline_chunks(fn, ...)`` receiving ``dispatch``)."""
-    out = set()
-    for n in ast.walk(fn_node):
-        if isinstance(n, ast.Call):
-            if isinstance(n.func, ast.Name):
-                out.add(n.func.id)
-            elif isinstance(n.func, ast.Attribute):
-                out.add(n.func.attr)
-        elif isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load):
-            out.add(n.id)
-    return out
-
-
-def _reachable(idx, roots=ROOTS) -> dict:
-    """{id(fn_node): (SourceFile, qualname, node)} reachable from
-    ``roots`` via simple-name edges (shared by R2 and R7)."""
-    seen: dict[int, tuple] = {}
-    work = []
-    for r in roots:
-        for ent in idx.get(r, ()):
-            if id(ent[2]) not in seen:
-                seen[id(ent[2])] = ent
-                work.append(ent)
-    while work:
-        _sf, _qn, node = work.pop()
-        for name in _called_names(node):
-            for ent in idx.get(name, ()):
-                if id(ent[2]) not in seen:
-                    seen[id(ent[2])] = ent
-                    work.append(ent)
-    return seen
-
-
-def _direct_body(qn: str, fn_node):
-    """(full qualname, nested-node id set to skip, suppression anchor
-    lines) for scanning a function's DIRECT body: nested defs are their
-    own graph nodes, and a def-line (or first-decorator-line)
-    suppression exempts the whole function — the shared R2/R7
-    per-function scaffolding."""
-    qn_full = f"{qn}.{fn_node.name}" if qn != "<module>" \
-        else fn_node.name
-    skip = set()
-    for nf in ast.walk(fn_node):
-        if isinstance(nf, (ast.FunctionDef, ast.AsyncFunctionDef)) \
-                and nf is not fn_node:
-            for x in ast.walk(nf):
-                skip.add(id(x))
-    def_lines = (fn_node.lineno,) + (
-        (fn_node.decorator_list[0].lineno,)
-        if fn_node.decorator_list else ())
-    return qn_full, skip, def_lines
-
-
 #: R7 reachability roots — R2's hot-path roots plus the band-migration
 #: pipeline and the multi-iteration distributed driver (the pod hot
 #: path, parallel/pod.py): these are the functions whose steady state
@@ -159,38 +94,35 @@ def check_r7(ctx) -> list:
     any 2-process run.  Legitimate escape hatches (budget-overflow
     fallbacks, checkpoint IO under cold_io, the final-output gather)
     carry reasoned suppressions."""
-    idx = _functions(ctx)
+    graph = flow.CallGraph(ctx, _SCOPE)
     out = []
-    for sf, qn, fn_node in _reachable(idx, R7_ROOTS).values():
-        qn_full, skip, def_lines = _direct_body(qn, fn_node)
-        for n in ast.walk(fn_node):
-            if id(n) in skip or not isinstance(n, ast.Call):
+    for fi in graph.reachable(R7_ROOTS):
+        for n in ast.walk(fi.node):
+            if id(n) in fi.nested_skip or not isinstance(n, ast.Call):
                 continue
             d = dotted(n.func)
             leaf = d.rsplit(".", 1)[-1] if d else ""
             if leaf not in _R7_CALLS:
                 continue
             out.append(Violation(
-                "R7", sf.rel, n.lineno, qn_full, leaf,
+                "R7", fi.sf.rel, n.lineno, fi.qualname, leaf,
                 f"escape-hatch allgather {leaf}() reachable from the "
                 f"pod hot path (roots: {', '.join(R7_ROOTS)}) — band "
                 "tables ride pod.gather_band",
-                anchor_lines=def_lines))
+                anchor_lines=fi.def_lines))
     return out
 
 
 @rule("R2")
 def check_r2(ctx) -> list:
-    idx = _functions(ctx)
-    reach = _reachable(idx)
+    graph = flow.CallGraph(ctx, _SCOPE)
     out = []
-    for sf, qn, fn_node in reach.values():
+    for fi in graph.reachable(ROOTS):
         # direct body only (nested defs are separate graph nodes); the
         # def/decorator lines anchor whole-function fallback
-        # suppressions — shared scaffolding, _direct_body
-        qn_full, skip, def_lines = _direct_body(qn, fn_node)
-        for n in ast.walk(fn_node):
-            if id(n) in skip or not isinstance(n, ast.Call):
+        # suppressions
+        for n in ast.walk(fi.node):
+            if id(n) in fi.nested_skip or not isinstance(n, ast.Call):
                 continue
             tag = None
             d = dotted(n.func)
@@ -210,8 +142,8 @@ def check_r2(ctx) -> list:
             # resolves a def-line suppression (whole-function fallback
             # exemption) and the pair still lands in report.suppressed
             out.append(Violation(
-                "R2", sf.rel, n.lineno, qn_full, tag,
+                "R2", fi.sf.rel, n.lineno, fi.qualname, tag,
                 f"host-sync {tag} reachable from the grouped/dist hot "
                 f"path (roots: {', '.join(ROOTS)})",
-                anchor_lines=def_lines))
+                anchor_lines=fi.def_lines))
     return out

@@ -1,0 +1,221 @@
+"""R10 shape-ladder escapes: every measured int that becomes a device
+array's shape must pass through the compile governor's ladder.
+
+``--ledger`` observes compile-family boundedness empirically, after
+paying the compiles; R10 proves the producing side statically.  A
+device-array constructor's shape IS a compile family per distinct
+value, so the sinks are the host-visible ``jnp.zeros/ones/full/empty``
+size arguments and ``jnp.pad`` widths.  The rule resolves each size
+expression backwards through the function's assignments (in source
+order — flow-sensitive reaching definitions) and fails when a
+**measurement** feeds the shape raw:
+
+- ``len(...)``, and data-dependent reductions called as methods or
+  via a module (``x.max()``, ``np.sum(...)``, ``counts.item()``...)
+
+unless the value passes through a **ladder producer** first:
+``bucket()``, ``pad_comm_tables()``, or any function whose returns are
+themselves ladder-derived (summarized to a fixed point, so
+``narrow_budget()``-style wrappers are recognized without a registry).
+
+Trusted by construction (the check happens where the measurement is):
+
+- parameters and attribute reads — a caller passing a raw measured
+  size is flagged at ITS measurement site;
+- ``.shape``/``.size`` of an existing array — an array built at a
+  bucketed capacity carries its ladder;
+- constants and arithmetic over trusted values (``capT * 6`` stays in
+  the family of ``capT``).
+
+Legitimately un-laddered shapes (one-shot ingest of a host mesh, the
+cold boundary where the input defines the family) carry a reasoned
+``# lint: ok(R10)``.
+"""
+from __future__ import annotations
+
+import ast
+
+from . import flow
+from .engine import Violation, dotted, rule
+
+_SCOPE = ("parmmg_tpu/",)
+_EXCLUDE = ("parmmg_tpu/lint/",)
+
+#: base ladder producers; extended each run by the returns-ladder
+#: summary fixpoint
+_LADDER_BASE = frozenset({"bucket", "pad_comm_tables"})
+
+#: constructor leaf -> positional index of the shape argument
+_SIZED = {"zeros": 0, "ones": 0, "full": 0, "empty": 0}
+_PAD = {"pad": 1}
+
+#: reductions that measure data when called as an attribute
+#: (``x.max()``, ``np.sum(...)``); builtins stay transparent
+_MEASURE_ATTRS = frozenset({"max", "min", "sum", "prod", "item",
+                            "count_nonzero", "argmax", "argmin",
+                            "nonzero", "searchsorted", "tolist"})
+
+#: transparent numeric wrappers — recurse into their arguments
+_TRANSPARENT = frozenset({"int", "float", "bool", "abs", "round",
+                          "max", "min", "sum", "divmod"})
+
+
+def _device_ns(call) -> bool:
+    d = dotted(call.func)
+    return d.startswith("jnp.") or d.startswith("jax.numpy.")
+
+
+def _ladder_names(graph) -> set:
+    """Function names whose returns are ladder-derived: a return value
+    containing a call to a known ladder producer, to a fixed point."""
+    names = set(_LADDER_BASE)
+    changed = True
+    while changed:
+        changed = False
+        for fi in graph.infos:
+            if fi.name in names:
+                continue
+            for n in ast.walk(fi.node):
+                if id(n) in fi.nested_skip \
+                        or not isinstance(n, ast.Return) \
+                        or n.value is None:
+                    continue
+                if any(isinstance(c, ast.Call)
+                       and flow.leaf_name(c.func) in names
+                       for c in ast.walk(n.value)):
+                    names.add(fi.name)
+                    changed = True
+                    break
+    return names
+
+
+def _first_raw(expr, env, ladder, seen=()):
+    """Tag of the first raw measurement in a (resolved) shape
+    expression, or None when every leaf is ladder/trusted."""
+    if isinstance(expr, ast.Call):
+        leaf = flow.leaf_name(expr.func)
+        if leaf in ladder:
+            return None          # laundered: the ladder bounds it
+        if leaf == "len":
+            return "len()"
+        if isinstance(expr.func, ast.Attribute) \
+                and leaf in _MEASURE_ATTRS:
+            return f".{leaf}()"
+        if isinstance(expr.func, ast.Name) and leaf in _TRANSPARENT:
+            for a in expr.args:
+                got = _first_raw(a, env, ladder, seen)
+                if got:
+                    return got
+            return None
+        # unknown callee: its own returns are checked at ITS sinks
+        return None
+    if isinstance(expr, ast.Name):
+        if expr.id in seen:
+            return None
+        bound = env.get(expr.id)
+        if bound is None:
+            return None          # parameter / outer scope: trusted
+        return _first_raw(bound, env, ladder, seen + (expr.id,))
+    if isinstance(expr, ast.Attribute):
+        return None              # .shape/.size/self.cap: inherits
+    if isinstance(expr, (ast.Constant,)):
+        return None
+    for child in ast.iter_child_nodes(expr):
+        got = _first_raw(child, env, ladder, seen)
+        if got:
+            return got
+    return None
+
+
+def _scan_function(fi, ladder, out):
+    """Walk the direct body in source order, tracking simple Name
+    bindings (reaching definitions), checking each constructor sink
+    against the bindings live at that point."""
+    env: dict[str, object] = {}
+
+    def check_expr(root):
+        for n in ast.walk(root):
+            if id(n) in fi.nested_skip or not isinstance(n, ast.Call):
+                continue
+            leaf = flow.leaf_name(n.func)
+            size = None
+            if _device_ns(n) and leaf in _SIZED:
+                if n.args:
+                    size = n.args[0]
+                else:
+                    size = next((kw.value for kw in n.keywords
+                                 if kw.arg == "shape"), None)
+            elif _device_ns(n) and leaf in _PAD:
+                if len(n.args) > _PAD[leaf]:
+                    size = n.args[_PAD[leaf]]
+                else:
+                    size = next((kw.value for kw in n.keywords
+                                 if kw.arg == "pad_width"), None)
+            if size is None:
+                continue
+            raw = _first_raw(size, env, ladder)
+            if raw:
+                out.append(Violation(
+                    "R10", fi.sf.rel, n.lineno, fi.qualname,
+                    f"raw-shape:{leaf}:{raw}",
+                    f"jnp.{leaf}() shape fed by raw measurement "
+                    f"{raw} — every distinct value is a new compile "
+                    "family; route it through bucket()/"
+                    "pad_comm_tables() (or suppress at a one-shot "
+                    "ingest boundary with the reason)"))
+
+    def walk(body):
+        for stmt in body:
+            if id(stmt) in fi.nested_skip or isinstance(
+                    stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                           ast.ClassDef)):
+                continue
+            # sinks first: an assignment's RHS sees the env BEFORE it
+            for root in _stmt_roots(stmt):
+                check_expr(root)
+            if isinstance(stmt, ast.Assign) \
+                    and isinstance(stmt.value, ast.expr):
+                for t in stmt.targets:
+                    if isinstance(t, ast.Name):
+                        env[t.id] = stmt.value
+            elif isinstance(stmt, ast.AnnAssign) \
+                    and stmt.value is not None \
+                    and isinstance(stmt.target, ast.Name):
+                env[stmt.target.id] = stmt.value
+            if isinstance(stmt, ast.If):
+                walk(stmt.body)
+                walk(stmt.orelse)
+            elif isinstance(stmt, (ast.For, ast.AsyncFor, ast.While)):
+                walk(stmt.body)
+                walk(stmt.orelse)
+            elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+                walk(stmt.body)
+            elif isinstance(stmt, ast.Try):
+                walk(stmt.body)
+                for h in stmt.handlers:
+                    walk(h.body)
+                walk(stmt.orelse)
+                walk(stmt.finalbody)
+
+    def _stmt_roots(stmt):
+        if isinstance(stmt, (ast.If, ast.While)):
+            return [stmt.test]
+        if isinstance(stmt, (ast.For, ast.AsyncFor)):
+            return [stmt.iter]
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            return [i.context_expr for i in stmt.items]
+        if isinstance(stmt, ast.Try):
+            return []
+        return [stmt]
+
+    walk(fi.node.body)
+
+
+@rule("R10")
+def check_r10(ctx) -> list:
+    graph = flow.CallGraph(ctx, _SCOPE, _EXCLUDE)
+    ladder = _ladder_names(graph)
+    out: list = []
+    for fi in graph.infos:
+        _scan_function(fi, ladder, out)
+    return out

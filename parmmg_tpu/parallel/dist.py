@@ -799,7 +799,8 @@ def distributed_adapt_multi(mesh: Mesh, met, n_shards: int,
                           graph_repartition_labels, apply_fresh_ids,
                           kill_glo_rows)
     from .multihost import (require_single_process, pull_host as _pull,
-                            is_multiprocess, hot_path, cold_io)
+                            is_multiprocess, hot_path, cold_io,
+                            mh_uniform)
 
     # Multi-process contract (round 4, the mpi_pmmg.h role): every
     # process runs THIS SAME driver on the SAME input mesh (identical
@@ -920,7 +921,13 @@ def distributed_adapt_multi(mesh: Mesh, met, n_shards: int,
             from ..resilience.checkpoint import crash_loop
             _, esc = crash_loop(
                 ckpt_tag, ckpt_fp, it0,
-                write=(not multi) or jax.process_index() == 0)
+                write=mh_uniform(
+                    (not multi) or jax.process_index() == 0,
+                    "rank-0-writes: the attempt file lives on shared "
+                    "storage, so only process 0 appends; the escalate "
+                    "decision itself is re-agreed right below via "
+                    "process_allgather(max), every rank skips the "
+                    "same passes"))
             if multi:
                 from jax.experimental import multihost_utils
                 # lint: ok(R7) — pre-loop resume agreement on 4 bytes
@@ -1277,8 +1284,15 @@ def distributed_adapt_multi(mesh: Mesh, met, n_shards: int,
                             shared_prev if shared_prev is not None
                             else np.zeros(0, np.int64),
                             regrow_state[0], fingerprint=ckpt_fp,
-                            write=(not multi)
-                            or jax.process_index() == 0)
+                            write=mh_uniform(
+                                (not multi)
+                                or jax.process_index() == 0,
+                                "rank-0-writes: every rank computed "
+                                "the identical checkpoint payload "
+                                "(the cold_io collective pull above "
+                                "replicated it); process 0 durably "
+                                "writes, the others only needed the "
+                                "agreement"))
             otrace.profile_pass_end(it)
     otrace.set_context(**{"pass": None})
     _t_seg = time.perf_counter()

@@ -45,6 +45,31 @@ import os
 import numpy as np
 
 
+def mh_uniform(value, why: str):
+    """Identity marker asserting ``value`` is SPMD-safe: either agreed
+    across ranks (same value everywhere) or deliberately rank-scoped
+    with the agreement protocol described in ``why``.
+
+    The flagship use is the rank-0-writes idiom::
+
+        write=mh_uniform((not multi) or jax.process_index() == 0,
+                         "rank 0 durably writes; every rank computed "
+                         "the identical predicate shape")
+
+    Lint rule R8 (parmmg_tpu/lint/rules_spmd.py) taints everything
+    derived from ``jax.process_index()`` and flags collectives or side
+    effects that depend on the taint; ``mh_uniform``'s RESULT is
+    untainted, so wrapping a value here is the in-code, reasoned
+    alternative to a ``# lint: ok(R8)`` comment.  ``why`` is mandatory
+    for the same reason suppression reasons are: the assertion is only
+    as good as its argument.
+    """
+    if not why or not why.strip():
+        raise ValueError("mh_uniform() requires a non-empty 'why' "
+                         "describing the cross-rank agreement")
+    return value
+
+
 def init_multihost(coordinator: str | None = None,
                    num_processes: int | None = None,
                    process_id: int | None = None) -> bool:
@@ -242,6 +267,11 @@ def shard_stacked_global(stacked_host, dmesh):
         pieces = []
         for i, d in enumerate(devs):
             if d.process_index == jax.process_index():
+                # lint: ok(R8) — rank-scoped BY DESIGN: each process
+                # uploads exactly its addressable shard slices; every
+                # rank runs this identical loop over the global device
+                # list, and make_array_from_single_device_arrays below
+                # is the agreement that assembles the pieces
                 pieces.append(jax.device_put(x[i * g:(i + 1) * g], d))
         return jax.make_array_from_single_device_arrays(
             x.shape, sh, pieces)

@@ -40,12 +40,15 @@ def _morton3(u: np.ndarray) -> np.ndarray:
 def morton_partition(centroids: np.ndarray, nparts: int,
                      weights: np.ndarray | None = None) -> np.ndarray:
     """Equal-weight contiguous-along-curve partition of points."""
-    c = np.asarray(centroids, np.float64)
+    # host-by-contract inputs (signature: np.ndarray): astype is a
+    # dtype view/copy of host memory, never a device pull
+    c = centroids.astype(np.float64, copy=False)
     lo = c.min(axis=0)
     span = np.maximum(c.max(axis=0) - lo, 1e-30)
     key = _morton3((c - lo) / span * 0.999999)
     order = np.argsort(key, kind="stable")
-    w = np.ones(len(c)) if weights is None else np.asarray(weights, float)
+    w = np.ones(len(c)) if weights is None \
+        else weights.astype(np.float64, copy=False)
     cw = np.cumsum(w[order])
     total = cw[-1]
     part_sorted = np.minimum((cw - 1e-12) / total * nparts,

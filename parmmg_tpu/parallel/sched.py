@@ -125,9 +125,11 @@ def quiet_rows(counts: np.ndarray) -> np.ndarray:
     :meth:`QuietGroupScheduler.record_block` (group granularity) and
     the serving pool (serve/pool.py, tenant granularity): one rule, one
     exactness argument (module docstring)."""
-    c = np.asarray(counts)
-    n = c.shape[0]
-    return c[..., :5].reshape(n, -1).sum(axis=1, dtype=np.int64) == 0
+    # host-by-contract: the drain already pulled the block counters to
+    # numpy ([n, nblk, >=5]) — no conversion, no possible device sync
+    n = counts.shape[0]
+    return counts[..., :5].reshape(n, -1).sum(axis=1,
+                                              dtype=np.int64) == 0
 
 
 def chunk_plans(act: np.ndarray, chunk: int) -> list:
@@ -197,8 +199,10 @@ class QuietGroupScheduler:
             act = np.where(self.level < skip)[0]
         else:
             act = np.arange(self.g_exec)
-        self.active_per_block.append(
-            int(np.sum(self.level[:self.ngroups] < skip)))
+        # level is host scheduler state (np.int8): count, then int() a
+        # bound host scalar — nothing here can sync a device value
+        n_active = np.count_nonzero(self.level[:self.ngroups] < skip)
+        self.active_per_block.append(int(n_active))
         if self.chunk:
             base = -(-self.g_exec // self.chunk)
             plans = chunk_plans(act, self.chunk) if len(act) else []
@@ -211,8 +215,8 @@ class QuietGroupScheduler:
         self.saved_dispatches += base - len(plans)
         # ...but the skipped-GROUP counter reports convergence, so it
         # counts REAL groups only (pads are dead at birth, not wins)
-        self.skipped_group_blocks += \
-            self.ngroups - int(np.sum(act < self.ngroups))
+        n_real = np.count_nonzero(act < self.ngroups)
+        self.skipped_group_blocks += self.ngroups - int(n_real)
         return act, plans
 
     def block_mask(self, pres_all_on: bool) -> np.ndarray:
@@ -265,7 +269,8 @@ class QuietGroupScheduler:
             return
         zero = quiet_rows(counts)
         lvl = LEVEL_PRE if pres_all_on else LEVEL_FULL
-        sel = np.asarray(act)[zero]
+        # act comes from plan_block (np.where/arange): already host
+        sel = act[zero]
         self.level[sel] = np.maximum(self.level[sel], lvl)
 
     def on_regrow(self) -> None:

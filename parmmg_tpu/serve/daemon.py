@@ -156,6 +156,12 @@ class PoolDaemon:
             # step's whole (possibly unbounded) duration, /healthz
             # stays lock-free by design
             with self._lock:
+                # lint: ok(R9) — the hold IS the design: serving and
+                # RPCs serialize on the RLock, and this exact hold is
+                # what run_with_deadline(PARMMG_DEADLINE_SERVE_S)
+                # bounds; the subprocess legs inside carry their own
+                # watchdogs (PARMMG_POLISH_TIMEOUT_S; the native-ext
+                # build is one-time and memoized)
                 return self.driver.service_once()
 
         while not self._stop.is_set():
@@ -183,11 +189,17 @@ class PoolDaemon:
                               f"{e.seconds:g}s deadline — wedged "
                               "(healthz not-ok) until it returns",
                            err=True)
+                # lint: ok(R9) — GIL-atomic bool store: only this loop
+                # thread ever writes _wedged; /healthz reads it
+                # lock-free BY DESIGN (a liveness probe must answer
+                # while the abandoned step still owns the RLock —
+                # taking the lock here would recreate the wedge)
                 self._wedged = True
                 th = getattr(e, "thread", None)
                 while th is not None and th.is_alive() \
                         and not self._stop.is_set():
                     self._stop.wait(max(self.idle_sleep_s, 0.1))
+                # lint: ok(R9) — same GIL-atomic probe flag as above
                 self._wedged = False
                 continue
             except Exception as e:
@@ -251,6 +263,10 @@ class PoolDaemon:
             q = False
             if tid:
                 with self._lock:
+                    # lint: ok(R9) — quarantine must retire the tenant
+                    # atomically with pool state (PR 9 isolation); the
+                    # only subprocess on its retire->merge path is the
+                    # one-time memoized native-extension build
                     q = self.driver.quarantine(
                         tid, f"daemon rpc fault: {e!r:.200}")
             REGISTRY.counter("serve.rpc_faults").inc()
@@ -332,13 +348,23 @@ class PoolDaemon:
                 rep = d.report(list(d._occupancy_traj))
             return 200, rep, None
         if op == "pause" and method == "POST":
+            # lint: ok(R9) — GIL-atomic bool store: pause/resume are
+            # the handler thread's only writes, the loop re-reads each
+            # iteration and /healthz reads lock-free by design; a
+            # one-iteration race just delays the pause by one step
             self.paused = True
             return 200, {"paused": True}, None
         if op == "resume" and method == "POST":
+            # lint: ok(R9) — same GIL-atomic operator flag as pause
             self.paused = False
             return 200, {"paused": False}, None
         if op == "step" and method == "POST":
             with self._lock:
+                # lint: ok(R9) — the ops 'step' RPC deliberately runs
+                # one synchronous serving step under the RLock (same
+                # work the loop bounds with PARMMG_DEADLINE_SERVE_S);
+                # its subprocess legs carry PARMMG_POLISH_TIMEOUT_S
+                # and the one-time native build
                 st = d.service_once()
             return 200, {"state": st}, None
         if op == "shutdown" and method == "POST":

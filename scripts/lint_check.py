@@ -1,7 +1,7 @@
 #!/usr/bin/env python
 """Static invariant lint gate (``run_tests.sh --lint``).
 
-Runs the R1-R6 AST rules over the tree in a few seconds — no jax
+Runs the R1-R10 AST rules over the tree in a few seconds — no jax
 import, no compiles — and fails on any violation that is neither
 suppressed in source (``# lint: ok(<rule>) — reason``) nor grandfathered
 in ``lint_baseline.json``.  R4 (knob registry) ignores the baseline:
@@ -11,6 +11,15 @@ Usage:
     python scripts/lint_check.py                 # the gate
     python scripts/lint_check.py -v              # + per-rule listings
     python scripts/lint_check.py --rules R3,R4   # subset
+    python scripts/lint_check.py --sarif out.sarif
+        also write a SARIF 2.1.0 log: one result per violation (new =
+        error, baselined = warning, suppressed results carry their
+        in-source justification) for CI annotation surfaces
+    python scripts/lint_check.py --changed-only
+        analyze the WHOLE tree (the interprocedural summaries need it)
+        but report and gate only findings in git-dirty files — the
+        inner-loop mode: your edit either introduced the finding or
+        touched the file that holds it
     python scripts/lint_check.py --baseline-update
         rewrite lint_baseline.json to the current violation set (an
         intentional rotation: do this only in the PR that argues why)
@@ -19,6 +28,7 @@ from __future__ import annotations
 
 import argparse
 import os
+import subprocess
 import sys
 import time
 
@@ -28,6 +38,94 @@ sys.path.insert(0, ROOT)
 BASELINE = os.path.join(ROOT, "lint_baseline.json")
 
 
+def _changed_files() -> set:
+    """Repo-relative paths of git-dirty files (staged, unstaged and
+    untracked) — the --changed-only report filter."""
+    try:
+        out = subprocess.run(
+            ["git", "status", "--porcelain"], cwd=ROOT,
+            capture_output=True, text=True, timeout=30, check=True,
+        ).stdout
+    except Exception as e:
+        print(f"lint: --changed-only needs git ({e})", file=sys.stderr)
+        return set()
+    rels = set()
+    for ln in out.splitlines():
+        if len(ln) < 4:
+            continue
+        path = ln[3:]
+        # renames show as "old -> new": the NEW path holds the code
+        if " -> " in path:
+            path = path.split(" -> ", 1)[1]
+        rels.add(path.strip().strip('"'))
+    return rels
+
+
+def _sarif_payload(report, result, titles) -> dict:
+    """SARIF 2.1.0: one result per violation.  New violations are
+    errors, baselined ones warnings (with the gate state in
+    properties), suppressed ones carry their in-source reason as a
+    SARIF suppression; SUPP problems (reasonless/unknown-rule
+    comments, parse errors) are errors under the pseudo-rule SUPP."""
+    new_ids = {id(v) for v in result.new}
+
+    def loc(v):
+        region = {"startLine": max(int(v.line), 1)}
+        return [{"physicalLocation": {
+            "artifactLocation": {"uri": v.path,
+                                 "uriBaseId": "SRCROOT"},
+            "region": region}}]
+
+    def res(v, level, state, suppression=None):
+        r = {"ruleId": v.rule,
+             "level": level,
+             "message": {"text": v.message},
+             "locations": loc(v),
+             "properties": {"state": state,
+                            "scope": v.scope,
+                            "detail": v.detail,
+                            "key": v.key}}
+        if suppression is not None:
+            r["suppressions"] = [{
+                "kind": "inSource",
+                "justification": suppression.reason,
+                "properties": {
+                    "commentLine": suppression.comment_line}}]
+        return r
+
+    results = []
+    for v in result.bad:
+        results.append(res(v, "error", "suppression-problem"))
+    for v in report.violations:
+        if id(v) in new_ids:
+            results.append(res(v, "error", "new"))
+        else:
+            results.append(res(v, "warning", "baselined"))
+    for v, s in report.suppressed:
+        results.append(res(v, "note", "suppressed", suppression=s))
+
+    rules = [{"id": rid,
+              "shortDescription": {"text": titles.get(rid, rid)}}
+             for rid in sorted(titles)]
+    rules.append({"id": "SUPP", "shortDescription": {
+        "text": "suppression hygiene (reason mandatory, rule ids must "
+                "exist, files must parse)"}})
+    return {
+        "$schema": "https://docs.oasis-open.org/sarif/sarif/v2.1.0/"
+                   "errata01/os/schemas/sarif-schema-2.1.0.json",
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": {
+                "name": "parmmg-lint",
+                "informationUri":
+                    "parmmg_tpu/lint/__init__.py",
+                "rules": rules}},
+            "originalUriBaseIds": {"SRCROOT": {"uri": "file:///"}},
+            "results": results,
+        }],
+    }
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--rules", default="",
@@ -35,6 +133,12 @@ def main(argv=None) -> int:
     ap.add_argument("--baseline-update", action="store_true",
                     help="rewrite lint_baseline.json from the current "
                          "violations (R4 stays unbaselined)")
+    ap.add_argument("--sarif", metavar="PATH", default="",
+                    help="write a SARIF 2.1.0 log of every violation "
+                         "(new/baselined/suppressed) to PATH")
+    ap.add_argument("--changed-only", action="store_true",
+                    help="report and gate only findings in git-dirty "
+                         "files (analysis still covers the whole tree)")
     ap.add_argument("-v", "--verbose", action="store_true")
     args = ap.parse_args(argv)
 
@@ -68,7 +172,35 @@ def main(argv=None) -> int:
 
     baseline = lint.load_baseline(BASELINE)
     result = lint.gate(report, baseline)
+
+    if args.changed_only:
+        changed = _changed_files()
+        # the SUMMARIES were computed over the full tree (an edit in
+        # a callee changes facts at untouched call sites — those still
+        # surface in the next full run / CI); the REPORT narrows to
+        # what the working copy actually touches
+        report = lint.LintReport(
+            [v for v in report.violations if v.path in changed],
+            [(v, s) for v, s in report.suppressed
+             if v.path in changed],
+            [v for v in report.bad if v.path in changed])
+        result = lint.GateResult(
+            [v for v in result.new if v.path in changed],
+            [v for v in result.bad if v.path in changed],
+            result.burndown)
+        print(f"lint: --changed-only over {len(changed)} dirty "
+              "file(s)")
+
     print(lint.format_report(report, result))
+
+    if args.sarif:
+        import json
+        doc = _sarif_payload(report, result, lint.RULE_TITLES)
+        with open(args.sarif, "w", encoding="utf-8") as f:
+            json.dump(doc, f, indent=1, sort_keys=True)
+            f.write("\n")
+        n = len(doc["runs"][0]["results"])
+        print(f"lint: SARIF log with {n} result(s) -> {args.sarif}")
 
     if args.verbose:
         print("\n-- suppressed (reasoned, in-source) --")

@@ -10,14 +10,19 @@
 set -u
 cd "$(dirname "$0")/.."
 
-# --lint: static invariant gate (scripts/lint_check.py) — R1-R6 AST
+# --lint: static invariant gate (scripts/lint_check.py) — R1-R10 AST
 # rules over the whole tree in seconds, no jax import, no compiles:
 # jit-hygiene, hot-path host-sync, obs print routing, PARMMG_* knob
-# registry, jaxcompat shim discipline, static telemetry names.  Zero
-# unsuppressed non-baselined violations allowed (lint_baseline.json is
-# the grandfathered burn-down list; R4 runs with no baseline at all).
+# registry, jaxcompat shim discipline, static telemetry names, plus
+# the flow-sensitive provers (R8 SPMD collective alignment, R9 lock
+# discipline, R10 shape-ladder escapes).  Zero unsuppressed
+# non-baselined violations allowed (lint_baseline.json is the
+# grandfathered burn-down list; R4 runs with no baseline at all).
+# Extra args pass through: `run_tests.sh --lint --sarif out.sarif`,
+# `run_tests.sh --lint --changed-only`, `--rules R8,R9`, `-v`.
 if [ "${1:-}" = "--lint" ]; then
-    exec python scripts/lint_check.py
+    shift
+    exec python scripts/lint_check.py "$@"
 fi
 
 # The compile-heavy gates below pay minutes of XLA:CPU compile — run
@@ -95,7 +100,7 @@ fi
 
 fail=0
 # static lint first: costs seconds, fails before any compile is paid
-echo "=== lint (static invariants R1-R6)"
+echo "=== lint (static invariants R1-R10)"
 python scripts/lint_check.py || fail=1
 for f in tests/test_*.py; do
     echo "=== $f"
