@@ -452,7 +452,11 @@ def main() -> None:
                # compile_s} — a regression shows up as variants or
                # compiles growing with the cycle count
                "compile_ledger": ledger,
-               "ledger_regressions": regressions})))
+               "ledger_regressions": regressions,
+               # free-form round context (BENCH_NOTES env) — e.g. a
+               # runner-image change that shifts absolute times, with
+               # the same-machine seed re-measurement for comparison
+               "notes": os.environ.get("BENCH_NOTES") or None})))
 
 
 def _ledger_regressions_vs_previous(ledger: dict) -> list[str]:

@@ -55,6 +55,12 @@ KNOBS: dict[str, Knob] = {
         "= checkpointing off"),
     "PARMMG_CKPT_EVERY": Knob(
         "int", "1", "checkpoint every Nth outer pass"),
+    "PARMMG_COLLAPSE_BAND": Knob(
+        "flag", "1",
+        "donor-scoped collapse apply: run the collapse tag/ref join "
+        "scatters on a geo-bucketed donor band instead of full [capT] "
+        "width, bit-identical by the band coverage proof "
+        "(ops/collapse.py); 0 = always full width"),
     "PARMMG_CYCLE_BLOCK": Knob(
         "int", "",
         "override cycles per compiled adapt block (ops/adapt.py); "
@@ -156,6 +162,12 @@ KNOBS: dict[str, Knob] = {
         "int", "",
         "narrow-row budget divisor override (ops/active.py); empty = "
         "tuned default"),
+    "PARMMG_PALLAS_SCORE": Knob(
+        "flag", "1",
+        "Pallas candidate-scoring kernels for the split/collapse/swap "
+        "top-k budget prep (ops/pallas_kernels.py; dispatched on TPU "
+        "only — CPU always uses the bit-identical jnp reference); "
+        "0 = jnp reference everywhere"),
     "PARMMG_POLISH_SUBPROC": Knob(
         "flag", "",
         "grouped polish phase in a subprocess worker (the TPU-tunnel "
@@ -241,6 +253,13 @@ KNOBS: dict[str, Knob] = {
         "float", "0",
         "serve driver: per-request wall-clock timeout; the slot is "
         "reclaimed (0 = off)"),
+    "PARMMG_SMOOTH_CADENCE": Knob(
+        "flag", "1",
+        "quality-triggered smoothing cadence: skip smooth_wave on a "
+        "cycle whose topology counts are zero and whose previous "
+        "smoothing moved nothing — an exact fixed point "
+        "(ops/adapt.py); threaded as a traced scalar so toggling "
+        "mints zero compile families; 0 = smooth every cycle"),
     "PARMMG_SOAK_RUNS": Knob(
         "int", "8",
         "scripts/chaos_soak.py default campaign length (seeded runs "
@@ -249,6 +268,14 @@ KNOBS: dict[str, Knob] = {
         "int", "20260804",
         "scripts/chaos_soak.py campaign seed: the fault schedule is a "
         "pure function of (seed, runs)"),
+    "PARMMG_SWAP_FACESORT": Knob(
+        "flag", "",
+        "pair swap23 candidates directly off the face-sort records, "
+        "skipping the cycle-interior build_adjacency rebuild "
+        "(ops/swap.py); bit-identical pairing by the argmin/argmax2 "
+        "tie-break equivalence; unset = on for TPU, off elsewhere "
+        "(the CPU sort costs more than the rebuild it replaces); "
+        "1/0 force either path on any backend"),
     "PARMMG_TEST_CACHE": Knob(
         "flag", "",
         "1 = opt the test processes into the persistent compile cache "

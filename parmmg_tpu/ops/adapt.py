@@ -105,7 +105,8 @@ def adapt_cycle_impl(mesh: Mesh, met: jax.Array, wave: jax.Array,
                      budget_div: int = 8,
                      et0=None, vact=None, submesh: bool = False,
                      wide: bool = False, wwin=None,
-                     prescreen: bool = True, active=None):
+                     prescreen: bool = True, active=None,
+                     smooth_idle=None):
     """One adaptation cycle: split -> collapse -> [swap] -> [smooth].
 
     Pure jittable function (jitted wrapper below) — also the compile-check
@@ -151,6 +152,22 @@ def adapt_cycle_impl(mesh: Mesh, met: jax.Array, wave: jax.Array,
     a zero-op state is byte-identity, so returning the input IS the
     recompute).  ``active=None`` compiles the unconditional body — the
     whole-mesh path is untouched.
+
+    ``smooth_idle``: optional traced scalar bool — the smoothing-cadence
+    carry (PARMMG_SMOOTH_CADENCE, parallel/sched.cadence_enabled): True
+    means the PREVIOUS cycle was a full no-op (zero topo ops AND zero
+    smoothing moves).  When also THIS cycle's topo counts are zero, the
+    smoothing wave is ``lax.cond``-skipped — provably an identity:
+    smooth_wave's proposals are wave-independent and its claim
+    resolution cannot rob the globally best improving vertex, so
+    nmoved == 0 ⟺ no vertex improves ⟺ the wave is the identity map,
+    and the emptiness of the improving set is wave-rotation-invariant
+    (ops/smooth.py) — re-running it on the byte-identical mesh of a
+    topo-quiet successor cycle would again move nothing.  The skipped
+    wave truthfully reports nmoved = 0, so the carry chain stays exact.
+    Like ``active``, it is a TRACED argument: toggling the cadence
+    never mints a new compile family.  Only used on the full-width path
+    (callers pass None alongside vact/wwin restrictions).
     """
     from .adjacency import boundary_edge_tags
     if active is not None:
@@ -162,7 +179,7 @@ def adapt_cycle_impl(mesh: Mesh, met: jax.Array, wave: jax.Array,
                 final_rebuild=final_rebuild, hausd=hausd,
                 budget_div=budget_div, et0=et0, vact=vact,
                 submesh=submesh, wide=wide, wwin=wwin,
-                prescreen=prescreen)
+                prescreen=prescreen, smooth_idle=smooth_idle)
 
         def _skip(ops):
             m, k = ops
@@ -228,13 +245,23 @@ def adapt_cycle_impl(mesh: Mesh, met: jax.Array, wave: jax.Array,
 
     nswap = jnp.zeros((), jnp.int32)
     if do_swap:
+        from .swap import swap_facesort_enabled
         sew = swap_edges_wave(mesh, met, hausd=hausd,
                               budget_div=budget_div,
                               vact=vact, wwin=wwin)  # 3-2 + 2-2
-        # consumed by swap23 (adja-only on a sub-mesh: cut faces are
-        # unmatched without being surface)
-        mesh = build_adjacency(sew.mesh, set_bdy_tags=not submesh)
-        s23 = swap23_wave(mesh, met, budget_div=budget_div, wwin=wwin)
+        if swap_facesort_enabled():
+            # swap23 pairs directly off the face sort (bit-identical to
+            # the adja path — ops/swap._pair_fields_facesort); the
+            # [capT,4] adja materialization + compare leaves the cycle
+            # interior, final_rebuild restores the adja contract
+            s23 = swap23_wave(sew.mesh, met, budget_div=budget_div,
+                              wwin=wwin, facesort=True,
+                              set_bdy_tags=not submesh)
+        else:
+            # consumed by swap23 (adja-only on a sub-mesh: cut faces are
+            # unmatched without being surface)
+            mesh = build_adjacency(sew.mesh, set_bdy_tags=not submesh)
+            s23 = swap23_wave(mesh, met, budget_div=budget_div, wwin=wwin)
         mesh = s23.mesh
         nswap = sew.nswap + s23.nswap
         defer_sw = defer_sw | sew.deferred | s23.deferred
@@ -245,11 +272,26 @@ def adapt_cycle_impl(mesh: Mesh, met: jax.Array, wave: jax.Array,
         # restricts to the window; in narrow mode vact (the worklist
         # closure, itself window-derived) is the restriction
         sv = vact if vact is not None else wwin
-        for w in range(smooth_waves):
-            sm = smooth_wave(mesh, met, wave=wave * smooth_waves + w,
-                             vact=sv)
-            mesh = sm.mesh
-            nmoved = nmoved + sm.nmoved
+
+        def _smooth(m):
+            nm = jnp.zeros((), jnp.int32)
+            for w in range(smooth_waves):
+                sm = smooth_wave(m, met, wave=wave * smooth_waves + w,
+                                 vact=sv)
+                m = sm.mesh
+                nm = nm + sm.nmoved
+            return m, nm
+
+        if smooth_idle is not None and sv is None:
+            # smoothing cadence (see docstring): skip is exact only on
+            # the full-width path — a window rotation changes the
+            # candidate set between cycles, so sv disables the gate
+            skip = smooth_idle & ((nsplit + ncol + nswap) == 0)
+            mesh, nmoved = jax.lax.cond(
+                skip, lambda m: (m, jnp.zeros((), jnp.int32)),
+                _smooth, mesh)
+        else:
+            mesh, nmoved = _smooth(mesh)
 
     if final_rebuild:
         mesh = build_adjacency(mesh, set_bdy_tags=not submesh)
@@ -299,7 +341,8 @@ def adapt_cycles_fused_impl(mesh: Mesh, met: jax.Array, wave0: jax.Array,
                             swap_flags: tuple | None = None,
                             do_smooth: bool = True,
                             do_insert: bool = True,
-                            budget_div: int = 8):
+                            budget_div: int = 8,
+                            cadence=None):
     """``n_cycles`` adaptation cycles in ONE jitted program.
 
     On a remote-attached TPU every dispatch pays a transport round trip
@@ -315,6 +358,13 @@ def adapt_cycles_fused_impl(mesh: Mesh, met: jax.Array, wave0: jax.Array,
     that cycle's winner set (split_wave drops the lowest-priority winners
     that don't fit); the flag is reported per cycle so the host can regrow
     and rerun as usual.
+
+    ``cadence``: optional traced scalar bool (PARMMG_SMOOTH_CADENCE) —
+    threads the smoothing-cadence carry across the block's cycles: after
+    a full no-op cycle (zero topo ops, zero moves), the next topo-quiet
+    cycle's smoothing wave is skipped as a proven identity (see
+    adapt_cycle_impl's ``smooth_idle``).  The carry is derived on-device
+    from each cycle's counts, so the cadence costs no extra transfer.
     """
     if swap_flags is None:
         swap_flags = tuple(
@@ -328,6 +378,7 @@ def adapt_cycles_fused_impl(mesh: Mesh, met: jax.Array, wave0: jax.Array,
     from .edges import unique_edges
     prev_et = None
     prev_ok = None
+    sm_idle = None if cadence is None else jnp.zeros((), bool)
     for c, dosw in enumerate(swap_flags):
         et_c = None
         if do_insert:
@@ -346,8 +397,12 @@ def adapt_cycles_fused_impl(mesh: Mesh, met: jax.Array, wave0: jax.Array,
             mesh, met, wave0 + c, do_swap=dosw,
             do_smooth=do_smooth, do_insert=do_insert,
             final_rebuild=(c == len(swap_flags) - 1), hausd=hausd,
-            budget_div=budget_div, et0=et_c)
+            budget_div=budget_div, et0=et_c,
+            smooth_idle=None if sm_idle is None else (cadence & sm_idle))
         counts_all.append(counts)
+        if sm_idle is not None:
+            sm_idle = ((counts[0] + counts[1] + counts[2]) == 0) & \
+                (counts[3] == 0)
         if do_insert:
             prev_et = et_c
             prev_ok = (counts[0] + counts[1] + counts[2]) == 0
@@ -429,14 +484,18 @@ def sliver_polish_impl(mesh: Mesh, met: jax.Array, wave: jax.Array,
         ncol = col.ncollapse
     if do_swap:
         from .swapgen import swapgen_wave
+        from .swap import swap_facesort_enabled
         sew = swap_edges_wave(mesh, met, hausd=hausd,
                               budget_div=2)  # 3-2 + 2-2
         # generalized degree 4-6 ring swaps: the worst surviving tets
         # are typically gate-limited for every lower-degree op — this
         # is the class that lifts the min past the 3-2/2-3 plateau
         sgn = swapgen_wave(sew.mesh, met, budget_div=2)
-        mesh = build_adjacency(sgn.mesh)        # consumed by swap23
-        s23 = swap23_wave(mesh, met, budget_div=2)
+        if swap_facesort_enabled():
+            s23 = swap23_wave(sgn.mesh, met, budget_div=2, facesort=True)
+        else:
+            mesh = build_adjacency(sgn.mesh)    # consumed by swap23
+            s23 = swap23_wave(mesh, met, budget_div=2)
         mesh = s23.mesh
         nswap = sew.nswap + sgn.nswap + s23.nswap
     if do_smooth:

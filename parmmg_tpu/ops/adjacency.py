@@ -34,17 +34,18 @@ def _face_keys(mesh: Mesh):
     return fv, tetid, faceid
 
 
-def build_adjacency(mesh: Mesh, set_bdy_tags: bool = True) -> Mesh:
-    """Compute ``adja`` and mark unmatched faces as boundary (MG_BDY).
+def face_sort(mesh: Mesh):
+    """THE face-sort pass, shared by ``build_adjacency`` and the direct
+    swap23 pairing (``ops.swap.swap23_wave(..., facesort=True)``).
 
-    In a conforming mesh every interior face appears exactly twice. After
-    sorting face keys, twins are neighbors in sorted order; the pairing is
-    scattered back as ``adja[t,f] = 4*t' + f'``.
-
-    ``set_bdy_tags=False`` computes adja only: on an active SUB-mesh
-    (ops/active.py) faces whose twin lies outside the sub-mesh are
-    unmatched without being boundary — tagging them MG_BDY would corrupt
-    the surface, while adja=-1 correctly excludes them from swap23.
+    Returns sorted-order face records ``(t, f, partner, matched,
+    valid_s)``: per sorted slot the tet id, local face id, the sorted-slot
+    index of the twin slot (self if unmatched), whether a twin exists, and
+    whether the slot belongs to a live tet.  Matched twins are adjacent in
+    sorted order, so ``(t[i], f[i]) <-> (t[partner[i]], f[partner[i]])``
+    IS the face-pair table — consumers that only need the pairing (swap23
+    candidate selection) read it here without materializing the [capT,4]
+    ``adja`` matrix.
     """
     from .edges import PACK_LIMIT
     capT = mesh.capT
@@ -71,6 +72,37 @@ def build_adjacency(mesh: Mesh, set_bdy_tags: bool = True) -> Mesh:
     idx = jnp.arange(capT * 4)
     partner = jnp.where(same_next, idx + 1, jnp.where(same_prev, idx - 1, idx))
     matched = same_next | same_prev
+    valid_s = k[:, 0] != big
+    return t, f, partner, matched, valid_s
+
+
+def bdy_tags_from_sort(mesh: Mesh, t, f, matched, valid_s):
+    """The MG_BDY face tagging of ``build_adjacency`` computed straight
+    off the face-sort records: a live unmatched slot IS a boundary face
+    (``adja < 0 & tmask`` of the adja path, by construction — adja is -1
+    exactly on unmatched live slots and dead rows).  One permutation
+    scatter replaces the adja materialization + compare."""
+    unb = valid_s & ~matched
+    hit = jnp.zeros((mesh.capT, 4), bool).at[t, f].set(
+        unb, unique_indices=True)
+    ftag = jnp.where(hit, mesh.ftag | MG_BDY, mesh.ftag)
+    return dataclasses_replace(mesh, ftag=ftag)
+
+
+def build_adjacency(mesh: Mesh, set_bdy_tags: bool = True) -> Mesh:
+    """Compute ``adja`` and mark unmatched faces as boundary (MG_BDY).
+
+    In a conforming mesh every interior face appears exactly twice. After
+    sorting face keys, twins are neighbors in sorted order; the pairing is
+    scattered back as ``adja[t,f] = 4*t' + f'``.
+
+    ``set_bdy_tags=False`` computes adja only: on an active SUB-mesh
+    (ops/active.py) faces whose twin lies outside the sub-mesh are
+    unmatched without being boundary — tagging them MG_BDY would corrupt
+    the surface, while adja=-1 correctly excludes them from swap23.
+    """
+    capT = mesh.capT
+    t, f, partner, matched, _ = face_sort(mesh)
     adj_val = jnp.where(matched, 4 * t[partner] + f[partner], -1)
 
     adja = jnp.full((capT, 4), -1, jnp.int32)

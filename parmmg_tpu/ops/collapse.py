@@ -185,9 +185,8 @@ def collapse_wave(mesh: Mesh, met: jax.Array, lmin: float = LSHRT,
         # candidates are picked up by the next wave.  Priority: shortest
         # edges in sizing mode; WORST incident tet in sliver mode (the pass
         # exists to raise the min — edge length would misrank the targets)
-        from .edges import wave_budget
+        from .edges import wave_budget, topk_prep
         K = min(Efull, wave_budget(capT, budget_div))
-        defer = jnp.sum(pre.astype(jnp.int32)) > K
         if sliver_q is None:
             prio = lens
         else:
@@ -196,8 +195,11 @@ def collapse_wave(mesh: Mesh, met: jax.Array, lmin: float = LSHRT,
                 jnp.repeat(jnp.where(bad_tet, q_tet, jnp.inf), 6),
                 mode="drop")
             prio = eq_min
-        # top-K by priority (smallest first) without a full-width argsort
-        _, sel = jax.lax.top_k(jnp.where(pre, -prio, -jnp.inf), K)
+        # fused scoring prep + top-K by priority (smallest first) without
+        # a full-width argsort
+        neg, npre = topk_prep(pre, prio)
+        defer = npre > K
+        _, sel = jax.lax.top_k(neg, K)
         lens_c = lens[sel]
         va = va_f[sel]
         vb = vb_f[sel]
@@ -395,8 +397,20 @@ def _collapse_apply(mesh: Mesh, met, win, rm, kp, capT, capP):
     return new_tet, tmask, vmask, ftag, fref, etag, has_donor_info
 
 
-def _collapse_tag_joins(mesh: Mesh, new_tet, dead, tmask, capT, capP):
-    """Keyed face/edge tag-transfer joins (see collapse_wave docstring)."""
+def _tag_joins_core(new_tet, ftag, fref, etag, donor, recv, capP):
+    """Width-generic body of the donor tag/ref keyed joins.
+
+    Runs over n = new_tet.shape[0] tet rows (the FULL capT width or a
+    compacted donor band — see ``_collapse_tag_joins``) and returns the
+    ADD arrays only: ``(add_tag [n,4] uint32, add_ref [n,4] int32,
+    add_e [n,6] uint32)``.  Rows with neither donor nor recv set are
+    keyed with the int32-max sentinel and contribute/receive nothing.
+    Segment aggregation is OR/max — commutative and associative — so the
+    adds per row are independent of the sort width n: a band containing
+    every donor and every key-matching receiver produces bit-identical
+    adds to the full-width join.
+    """
+    n = new_tet.shape[0]
     # --- transfer face tags/refs from dying tets: keyed face join --------
     # Every face of the REMAPPED mesh is keyed by its sorted vertex
     # triple; dying tets donate their old tags/refs, alive slots with the
@@ -409,10 +423,10 @@ def _collapse_tag_joins(mesh: Mesh, new_tet, dead, tmask, capT, capP):
     # silently dropped fref/REQ/REF bits).
     from ..core.mesh import tet_face_vertices
     from .edges import PACK_LIMIT, segmented_or, segmented_max
-    F4 = capT * 4
+    F4 = n * 4
     fvn = jnp.sort(tet_face_vertices(new_tet).reshape(F4, 3), axis=1)
-    donor_f = jnp.repeat(dead, 4)
-    recv_f = jnp.repeat(tmask, 4)
+    donor_f = jnp.repeat(donor, 4)
+    recv_f = jnp.repeat(recv, 4)
     rel_f = donor_f | recv_f
     i32max = jnp.iinfo(jnp.int32).max
     if capP <= PACK_LIMIT:
@@ -434,24 +448,21 @@ def _collapse_tag_joins(mesh: Mesh, new_tet, dead, tmask, capT, capP):
     seg_f = jax.lax.associative_scan(
         jnp.maximum, jnp.where(first_f, jnp.arange(F4), 0))
     is_last_f = jnp.concatenate([first_f[1:], jnp.array([True])])
-    dtag_f = jnp.where(donor_f[order_f], mesh.ftag.reshape(F4)[order_f], 0)
+    dtag_f = jnp.where(donor_f[order_f], ftag.reshape(F4)[order_f], 0)
     or_f = segmented_or(first_f, dtag_f)
     tot_tag = jnp.zeros(F4, jnp.uint32).at[
         jnp.where(is_last_f, seg_f, F4)].set(
         or_f, mode="drop", unique_indices=True)
     add_tag_s = tot_tag[seg_f]
     add_tag = jnp.zeros(F4, jnp.uint32).at[order_f].set(
-        add_tag_s, unique_indices=True).reshape(capT, 4)
-    dref_f = jnp.where(donor_f[order_f], mesh.fref.reshape(F4)[order_f], 0)
+        add_tag_s, unique_indices=True).reshape(n, 4)
+    dref_f = jnp.where(donor_f[order_f], fref.reshape(F4)[order_f], 0)
     mx_f = segmented_max(first_f, dref_f)
     tot_ref = jnp.zeros(F4, jnp.int32).at[
         jnp.where(is_last_f, seg_f, F4)].set(
         mx_f, mode="drop", unique_indices=True)
     add_ref = jnp.zeros(F4, jnp.int32).at[order_f].set(
-        tot_ref[seg_f], unique_indices=True).reshape(capT, 4)
-    ftag = jnp.where(tmask[:, None], mesh.ftag | add_tag, mesh.ftag)
-    fref = jnp.where(tmask[:, None] & (mesh.fref == 0) & (add_ref != 0),
-                     add_ref, mesh.fref)
+        tot_ref[seg_f], unique_indices=True).reshape(n, 4)
 
     # --- transfer edge tags from dying tets to surviving slots -----------
     # The collapse merges edge (u,rm) into (u,kp).  Mmg's colver unites
@@ -464,29 +475,103 @@ def _collapse_tag_joins(mesh: Mesh, new_tet, dead, tmask, capT, capP):
     # its receivers.
     from ..core.mesh import tet_edge_vertices
     from .edges import sort_pairs
-    ev_new = tet_edge_vertices(new_tet).reshape(capT * 6, 2)
+    ev_new = tet_edge_vertices(new_tet).reshape(n * 6, 2)
     ka = jnp.minimum(ev_new[:, 0], ev_new[:, 1])
     kb = jnp.maximum(ev_new[:, 0], ev_new[:, 1])
-    alive_s = jnp.repeat(tmask, 6)
-    donor_s = jnp.repeat(dead, 6)
+    alive_s = jnp.repeat(recv, 6)
+    donor_s = jnp.repeat(donor, 6)
     rel = alive_s | donor_s
     order, _, _, first = sort_pairs(ka, kb, rel, capP)
     seg = jax.lax.associative_scan(
-        jnp.maximum, jnp.where(first, jnp.arange(capT * 6), 0))
-    dtag = jnp.where(donor_s[order], mesh.etag.reshape(capT * 6)[order], 0)
+        jnp.maximum, jnp.where(first, jnp.arange(n * 6), 0))
+    dtag = jnp.where(donor_s[order], etag.reshape(n * 6)[order], 0)
     # segment OR of donor tags, then broadcast the segment total back to
     # every member (the OR-scan total sits at the LAST member)
-    from .edges import segmented_or
     or_fwd = segmented_or(first, dtag)
     is_last = jnp.concatenate([first[1:], jnp.array([True])])
     # per-segment total, scattered to the head slot then gathered by seg
     # id; buffer sized n6 exactly so the masked-out sentinel index n6 is
     # genuinely out of bounds (dropped) — required for unique_indices
-    total_at_head = jnp.zeros(capT * 6, jnp.uint32).at[
-        jnp.where(is_last, seg, capT * 6)].set(
+    total_at_head = jnp.zeros(n * 6, jnp.uint32).at[
+        jnp.where(is_last, seg, n * 6)].set(
         or_fwd, mode="drop", unique_indices=True)
     add_sorted = total_at_head[seg]                       # [capE] per slot
-    add = jnp.zeros(capT * 6, jnp.uint32).at[order].set(
-        add_sorted, unique_indices=True).reshape(capT, 6)
-    etag = jnp.where(tmask[:, None], mesh.etag | add, mesh.etag)
-    return ftag, fref, etag
+    add_e = jnp.zeros(n * 6, jnp.uint32).at[order].set(
+        add_sorted, unique_indices=True).reshape(n, 6)
+    return add_tag, add_ref, add_e
+
+
+def collapse_band_width(capT: int) -> int:
+    """Static donor-band width for ``_collapse_tag_joins``: geo-bucketed
+    (utils/compilecache.bucket — the existing shape ladder, so no new
+    shape families) from capT//4, never exceeding capT."""
+    from ..utils.compilecache import bucket
+    return bucket(max(1, capT // 4), floor=256, scheme="geo", cap=capT)
+
+
+def _collapse_tag_joins(mesh: Mesh, new_tet, dead, tmask, capT, capP):
+    """Keyed face/edge tag-transfer joins (see collapse_wave docstring).
+
+    PARMMG_COLLAPSE_BAND (default on): a steady-state wave kills ~30
+    tets, yet the joins sort 4*capT face keys and 6*capT edge keys.  The
+    banded path compacts the join to the DONOR BAND — the dead tets plus
+    every live tet containing a "relevant vertex" (a vertex of a
+    remapped dead tet) — and scatters the adds back.
+
+    Coverage proof (band result ≡ full result, bit for bit): every donor
+    key (face/edge of a remapped dead tet) has all its endpoints among
+    the relevant vertices, so any LIVE row matching a donor key contains
+    ≥2 relevant vertices and is in the band by construction; every
+    non-band row therefore lands in a segment with no donor and gets
+    add = 0 in the full-width join — exactly the zeros the band scatter
+    leaves behind.  Degenerate donor keys (the collapsed (kp,kp,·)
+    faces/edges of a dead tet) can never match a live row, whose
+    remapped vertices stay distinct.  Aggregation is OR/max, so segment
+    results are independent of the sort width (see _tag_joins_core).
+    The band width is static (collapse_band_width); when the band
+    overflows it — a mass-collapse wave — a lax.cond falls back to the
+    full-width join, which computes the identical result, so the switch
+    itself is parity-safe.
+    """
+    import os
+
+    def _merge(add_tag, add_ref, add_e):
+        ftag = jnp.where(tmask[:, None], mesh.ftag | add_tag, mesh.ftag)
+        fref = jnp.where(tmask[:, None] & (mesh.fref == 0) & (add_ref != 0),
+                         add_ref, mesh.fref)
+        etag = jnp.where(tmask[:, None], mesh.etag | add_e, mesh.etag)
+        return ftag, fref, etag
+
+    B = collapse_band_width(capT) \
+        if os.environ.get("PARMMG_COLLAPSE_BAND", "") != "0" else capT
+    if B >= capT:  # tiny meshes: the band ladder reaches capT anyway
+        return _merge(*_tag_joins_core(
+            new_tet, mesh.ftag, mesh.fref, mesh.etag, dead, tmask, capP))
+
+    # relevant vertices: every vertex of a remapped dead tet
+    rv = jnp.zeros(capP + 1, bool).at[
+        jnp.where(dead[:, None], new_tet, capP).reshape(-1)].max(
+        jnp.repeat(dead, 4), mode="drop")[:capP]
+    band = dead | (tmask & jnp.any(rv[new_tet], axis=1))
+    nband = jnp.sum(band.astype(jnp.int32))
+
+    def _banded(_):
+        rows = jnp.nonzero(band, size=B, fill_value=capT)[0]
+        vrow = rows < capT
+        rc = jnp.clip(rows, 0, capT - 1)
+        bt, br, be = _tag_joins_core(
+            new_tet[rc], mesh.ftag[rc], mesh.fref[rc], mesh.etag[rc],
+            dead[rc] & vrow, tmask[rc] & vrow, capP)
+        add_tag = jnp.zeros((capT, 4), jnp.uint32).at[rows].set(
+            bt, mode="drop", unique_indices=True)
+        add_ref = jnp.zeros((capT, 4), jnp.int32).at[rows].set(
+            br, mode="drop", unique_indices=True)
+        add_e = jnp.zeros((capT, 6), jnp.uint32).at[rows].set(
+            be, mode="drop", unique_indices=True)
+        return add_tag, add_ref, add_e
+
+    def _full(_):
+        return _tag_joins_core(new_tet, mesh.ftag, mesh.fref, mesh.etag,
+                               dead, tmask, capP)
+
+    return _merge(*jax.lax.cond(nband <= B, _banded, _full, None))

@@ -249,6 +249,60 @@ def edge_lengths(mesh: Mesh, et: EdgeTable, met: jax.Array) -> jax.Array:
     return ref(p0, p1, m0, m1)
 
 
+def topk_prep(cand: jax.Array, val: jax.Array):
+    """Top-k budget prep for a wave's candidate cut.
+
+    Returns ``(where(cand, -val, -inf), sum(cand))`` — the score vector
+    handed to ``lax.top_k`` and the int32 candidate count behind every
+    ``defer`` flag.  These are exactly the two expressions each wave
+    wrote inline, so wiring this in is bit-neutral; the TPU lowering
+    fuses them into one VMEM pass + cross-block reduction
+    (pallas_kernels.score_count_pallas, gated by PARMMG_PALLAS_SCORE),
+    every other platform keeps the jnp reference.
+    """
+    from functools import partial
+    from .pallas_kernels import (use_pallas, pallas_forced,
+                                 pallas_score_enabled, score_count_pallas)
+
+    def ref(c, v):
+        return jnp.where(c, -v, -jnp.inf), jnp.sum(c.astype(jnp.int32))
+
+    if use_pallas() and pallas_score_enabled():
+        from ..utils.jaxcompat import platform_dependent
+        off_tpu = (partial(score_count_pallas, interpret=True)
+                   if pallas_forced() else ref)
+        return platform_dependent(
+            cand, val,
+            tpu=partial(score_count_pallas, interpret=False),
+            default=off_tpu)
+    return ref(cand, val)
+
+
+def topk_prep3(cand: jax.Array, v0: jax.Array, v1: jax.Array,
+               v2: jax.Array):
+    """``topk_prep`` fused with the 3-way shell-quality minimum of
+    swap_edges_wave: ``val = min(v0, min(v1, v2))`` in that exact
+    association order (f32 minimum is exact, so the fused kernel is
+    bit-identical to the reference chain)."""
+    from functools import partial
+    from .pallas_kernels import (use_pallas, pallas_forced,
+                                 pallas_score_enabled, score3_count_pallas)
+
+    def ref(c, a, b, d):
+        v = jnp.minimum(a, jnp.minimum(b, d))
+        return jnp.where(c, -v, -jnp.inf), jnp.sum(c.astype(jnp.int32))
+
+    if use_pallas() and pallas_score_enabled():
+        from ..utils.jaxcompat import platform_dependent
+        off_tpu = (partial(score3_count_pallas, interpret=True)
+                   if pallas_forced() else ref)
+        return platform_dependent(
+            cand, v0, v1, v2,
+            tpu=partial(score3_count_pallas, interpret=False),
+            default=off_tpu)
+    return ref(cand, v0, v1, v2)
+
+
 def claim_shells(score, cand, shells, capT):
     """Exclusive multi-slot claims: winner must be the two-channel
     (score, tie-hash) max at EVERY shell slot it touches.  Winners are

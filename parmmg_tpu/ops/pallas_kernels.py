@@ -63,6 +63,15 @@ def pallas_forced() -> bool:
     return HAVE_PALLAS and os.environ.get("PARMMG_TPU_PALLAS", "") == "1"
 
 
+def pallas_score_enabled() -> bool:
+    """PARMMG_PALLAS_SCORE gate for the candidate-scoring kernels
+    (score_count_pallas / score3_count_pallas): default on — the
+    production dispatch in ops/edges.topk_prep is TPU-only either way,
+    so CPU runs are unaffected; =0 falls back to the jnp reference on
+    every backend."""
+    return os.environ.get("PARMMG_PALLAS_SCORE", "") != "0"
+
+
 def _pad_rows(n: int) -> int:
     """Rows of a [R,128] view holding n elements, R a multiple of 8."""
     r = -(-n // _LANE)
@@ -171,6 +180,86 @@ def edge_length_ani_pallas(p0: jax.Array, p1: jax.Array,
         interpret=_auto_interpret(interpret),
     )(*args)
     return _from_blocks(out, n, p0.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Candidate scoring + top-k budget prep: the wave selection preamble
+# (numerics identical to the jnp reference in ops/edges.py:topk_prep).
+# First non-elementwise kernels in this file: the candidate COUNT (the
+# defer/budget scalar every wave computes before lax.top_k) is reduced
+# across the sequential TPU grid into a (1,1) int32 ref — one pass
+# produces both the masked-negated score vector and the reduction.
+# ---------------------------------------------------------------------------
+def _score_kernel(m, v, out, cnt):
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _init():
+        cnt[0, 0] = 0
+
+    sel = m[:] > 0.0
+    out[:] = jnp.where(sel, -v[:], -jnp.inf)
+    cnt[0, 0] += jnp.sum(sel.astype(jnp.int32))
+
+
+def _score_min3_kernel(m, v0, v1, v2, out, cnt):
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _init():
+        cnt[0, 0] = 0
+
+    sel = m[:] > 0.0
+    v = jnp.minimum(v0[:], jnp.minimum(v1[:], v2[:]))
+    out[:] = jnp.where(sel, -v, -jnp.inf)
+    cnt[0, 0] += jnp.sum(sel.astype(jnp.int32))
+
+
+def score_count_pallas(mask: jax.Array, val: jax.Array,
+                       interpret: bool | None = None):
+    """Fused top-k prep: (where(mask, -val, -inf) [N], sum(mask) int32)."""
+    n = mask.shape[0]
+    rows = _pad_rows(n)
+    args = [_to_blocks(mask, rows), _to_blocks(val, rows)]
+    spec = pl.BlockSpec((_SUB, _LANE), lambda i: (i, 0))
+    # every grid step maps the count output to the SAME (1,1) block: the
+    # TPU grid is sequential, so += across steps is a legal reduction
+    cspec = pl.BlockSpec((1, 1), lambda i: (0, 0))
+    out, cnt = pl.pallas_call(
+        _score_kernel,
+        out_shape=(jax.ShapeDtypeStruct((rows, _LANE), jnp.float32),
+                   jax.ShapeDtypeStruct((1, 1), jnp.int32)),
+        grid=(rows // _SUB,),
+        in_specs=[spec] * 2,
+        out_specs=(spec, cspec),
+        interpret=_auto_interpret(interpret),
+    )(*args)
+    return _from_blocks(out, n, val.dtype), cnt[0, 0]
+
+
+def score3_count_pallas(mask: jax.Array, v0: jax.Array, v1: jax.Array,
+                        v2: jax.Array, interpret: bool | None = None):
+    """Fused shell-score top-k prep: min3 + mask + negate + count.
+
+    (where(mask, -min(v0,min(v1,v2)), -inf) [N], sum(mask) int32) — the
+    exact minimum chain order of the swap_edges_wave reference, so f32
+    results are bit-identical."""
+    n = mask.shape[0]
+    rows = _pad_rows(n)
+    args = [_to_blocks(mask, rows), _to_blocks(v0, rows),
+            _to_blocks(v1, rows), _to_blocks(v2, rows)]
+    spec = pl.BlockSpec((_SUB, _LANE), lambda i: (i, 0))
+    cspec = pl.BlockSpec((1, 1), lambda i: (0, 0))
+    out, cnt = pl.pallas_call(
+        _score_min3_kernel,
+        out_shape=(jax.ShapeDtypeStruct((rows, _LANE), jnp.float32),
+                   jax.ShapeDtypeStruct((1, 1), jnp.int32)),
+        grid=(rows // _SUB,),
+        in_specs=[spec] * 4,
+        out_specs=(spec, cspec),
+        interpret=_auto_interpret(interpret),
+    )(*args)
+    return _from_blocks(out, n, v0.dtype), cnt[0, 0]
 
 
 # ---------------------------------------------------------------------------

@@ -92,6 +92,18 @@ def smooth_wave(mesh: Mesh, met: jax.Array, wave: int = 0,
     the min needs lifting (Mmg's bad-element relocation in MMG3D_opttyp
     serves this role).  The relaxation cascade and the exact ball
     min-quality gate are unchanged.
+
+    Fixed-point invariant (the smoothing-cadence contract,
+    ops/adapt.adapt_cycle_impl ``smooth_idle``): on the full-width path
+    (``vact is None``) ``nmoved == 0`` iff NO vertex has an accepted
+    improving move — the globally best improving vertex can never lose
+    a claim, so an empty accepted set means the improving set itself is
+    empty, and that emptiness is invariant under the ``wave`` rotation
+    (proposals are wave-independent; ``wave`` only rotates claim
+    tie-breaks among winners).  A zero-move wave is therefore an exact
+    identity on the mesh, and skipping the NEXT wave after a fully
+    quiet cycle (no topology changes either) is bit-exact, not an
+    approximation.
     """
     capT, capP = mesh.capT, mesh.capP
     movable_int = mesh.vmask & ((mesh.vtag &

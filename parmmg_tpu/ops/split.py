@@ -179,7 +179,7 @@ def split_wave(mesh: Mesh, met: jax.Array, lmax: float = LLONG,
     def _act(_):
         from .quality import quality_from_points
         from ..core.constants import QUAL_FLOOR
-        from .edges import wave_budget
+        from .edges import topk_prep, wave_budget
         capE = et.ev.shape[0]
         ar0 = jnp.arange(capT)
         s, t = claim_channels(lens, cand)                 # sort-free priority
@@ -235,7 +235,10 @@ def split_wave(mesh: Mesh, met: jax.Array, lmax: float = LLONG,
         # the budget/offset stage was ~30 ms of the wave)
         KW = min(wave_budget(capT, budget_div), capE)
         KH = min(2 * wave_budget(capT, budget_div), capT)
-        vals, wc = jax.lax.top_k(jnp.where(win0, lens, -jnp.inf), KW)
+        # fused scoring prep (ops/edges.topk_prep wants smallest-first,
+        # so pass -lens: -(-lens) is a sign-bit round-trip, bit-exact)
+        neg, nwin = topk_prep(win0, -lens)
+        vals, wc = jax.lax.top_k(neg, KW)
         wv = vals > NEG_INF                               # real winners
         wcc = jnp.clip(wc, 0, capE - 1)
         # the KH shell-tet budget must bound the winner set BEFORE the
@@ -247,8 +250,7 @@ def split_wave(mesh: Mesh, met: jax.Array, lmax: float = LLONG,
         # budget deferral (top-K or shell-budget cut of VIABLE winners —
         # gate/capacity drops are flagged elsewhere): the narrow path's
         # worklist invariant needs to see this
-        defer = (jnp.sum(win0.astype(jnp.int32)) > KW) | \
-            jnp.any(wv & ~shell_fit)
+        defer = (nwin > KW) | jnp.any(wv & ~shell_fit)
         wv = wv & shell_fit
 
         # --- degeneracy veto (MMG5_split1b cavity-quality check) -------------

@@ -49,6 +49,30 @@ def _met6(met):
     return None if met.ndim == 1 else met
 
 
+def swap_facesort_enabled() -> bool:
+    """PARMMG_SWAP_FACESORT (default on): pair swap23 directly off the
+    face-sort records instead of materializing ``adja`` with a full
+    ``build_adjacency`` between the edge-swap and 2-3 waves — swap23 is
+    the only cycle-interior adja reader, and the facesort pairing is
+    bit-identical (see _pair_fields_facesort).  TRACE-TIME read: both
+    paths produce the same bits, so a stale jit cache entry is only a
+    perf choice, never a correctness one.
+
+    Platform-aware default (like the Pallas scoring dispatch): unset
+    means on for TPU, off elsewhere — the CPU backend's sort is slow
+    enough that the face re-sort costs more than the adja rebuild it
+    replaces (measured ~+7% s/cycle on the grouped bench), while on
+    TPU the sort amortizes and the rebuild's gather/compare does not.
+    ``1``/``0`` force the path on any backend (the parity tests and
+    the ledger gate force both arms on CPU)."""
+    import os
+    v = os.environ.get("PARMMG_SWAP_FACESORT", "")
+    if v == "":
+        import jax
+        return jax.default_backend() == "tpu"
+    return v != "0"
+
+
 def swap_edges_wave(mesh: Mesh, met: jax.Array, enable32: bool = True,
                     enable22: bool = True,
                     flat_tol: float = 1e-5,
@@ -104,7 +128,6 @@ def swap_edges_wave(mesh: Mesh, met: jax.Array, enable32: bool = True,
     qs0 = jnp.where(ft0_ >= 0, q_tet[s0f], jnp.inf)
     qs1 = jnp.where(ft1_ >= 0, q_tet[s1f], jnp.inf)
     qs2 = jnp.where(ft2_ >= 0, q_tet[s2f], jnp.inf)
-    q_shell = jnp.minimum(qs0, jnp.minimum(qs1, qs2))
     # STATIC gates go into the pre-mask at full width: a candidate that
     # can never pass (wrong tref pairing, missing shell slots) must not
     # pin a top-K slot wave after wave (it would never be deferred — the
@@ -138,11 +161,13 @@ def swap_edges_wave(mesh: Mesh, met: jax.Array, enable32: bool = True,
         pre32 = pre32 & wok
         pre22 = pre22 & wok
     pre = pre32 | pre22
-    from .edges import wave_budget
+    from .edges import wave_budget, topk_prep3
     K = min(Efull, wave_budget(capT, budget_div))
-    defer = jnp.sum(pre.astype(jnp.int32)) > K
+    # fused scoring prep (exact q_shell = min(qs0, min(qs1, qs2)) chain)
+    neg, npre = topk_prep3(pre, qs0, qs1, qs2)
+    defer = npre > K
     # top-K worst shells without a full-width argsort
-    _, sel = jax.lax.top_k(jnp.where(pre, -q_shell, -jnp.inf), K)
+    _, sel = jax.lax.top_k(neg, K)
 
     # ---- compacted columns ----------------------------------------------
     ev_c = et.ev[sel]
@@ -467,17 +492,9 @@ def swap22_wave(mesh: Mesh, met: jax.Array, flat_tol: float = 1e-5,
                            flat_tol=flat_tol, hausd=hausd)
 
 
-def swap23_wave(mesh: Mesh, met: jax.Array,
-                budget_div: int = 8,
-                wwin: jax.Array | None = None) -> SwapResult:
-    """2-to-3 swap: interior faces whose two tets improve as an edge fan.
-
-    Tets T1, T2 share interior face (p,q,r) with apexes a (in T1) and b (in
-    T2); replaced by (a,b,p,q), (a,b,q,r), (a,b,r,p) — two slots reused,
-    one allocated.
-    """
-    capT, capP = mesh.capT, mesh.capP
-    m6 = _met6(met)
+def _pair_fields_adja(mesh: Mesh, q_tet, capT):
+    """Legacy swap23 pairing off the materialized ``adja`` matrix:
+    per-tet candidate fields (fstar, t2_full, f2_full, cand_full)."""
     adja = mesh.adja
     nb = adja >> 2
     nf = adja & 3
@@ -491,7 +508,90 @@ def swap23_wave(mesh: Mesh, met: jax.Array,
     nf_s = jnp.clip(nf, 0, 3)
     own = own & (mesh.ftag == 0) & \
         (mesh.ftag[nb_s, nf_s] == 0)
+    q_nb = jnp.where(own, q_tet[nb_s], jnp.inf)          # [T,4]
+    fstar = jnp.argmin(q_nb, axis=1).astype(jnp.int32)   # [T]
+    arT = jnp.arange(capT)
+    t2_full = nb_s[arT, fstar]
+    f2_full = nf_s[arT, fstar]
+    cand_full = own[arT, fstar]
+    return fstar, t2_full, f2_full, cand_full
 
+
+def _pair_fields_facesort(mesh: Mesh, q_tet, capT, set_bdy_tags):
+    """Swap23 pairing DIRECTLY off the face-sort records — no [capT,4]
+    ``adja`` materialization, no per-tet [T,4] argmin machinery.
+
+    Bit-parity with :func:`_pair_fields_adja` on every row the wave can
+    consume:
+
+    * a sorted slot is ``own`` iff its legacy (t, f) entry is: matched
+      twins are exactly the ``adja >= 0`` entries (dead tets carry the
+      INT32_MAX key and never match, so both twins are live — the
+      ``valid``/``tmask[nb]`` conjuncts of the legacy mask hold by
+      construction), and the ownership/ftag gates are evaluated on the
+      same values;
+    * the per-tet winner face reproduces ``argmin(q_nb, axis=1)``'s
+      first-index tie-break exactly: the two-channel scatter-max with
+      channels (-q_twin, -f) picks the minimum twin quality and, among
+      float-equal minima, the smallest local face id (``scatter_argmax2``
+      is exact — the tie channel is unique per (tet, face));
+    * non-candidate rows default to 0 instead of the legacy clipped
+      garbage; every downstream read of those rows is masked by
+      ``cand_full`` (claims, scatters and the duplicate-edge veto all
+      route masked rows to the drop sentinel), so the applied mesh is
+      bit-identical — asserted by tests/test_hotloop.py.
+
+    When ``set_bdy_tags`` the MG_BDY face tagging of the legacy
+    ``build_adjacency`` call is applied from the same sort records, so
+    the ftag this function reads AND returns matches the legacy
+    sequence's exactly.  Returns (mesh', fstar, t2_full, f2_full,
+    cand_full)."""
+    from .adjacency import face_sort, bdy_tags_from_sort
+    from .edges import scatter_argmax2
+    t, f, partner, matched, valid_s = face_sort(mesh)
+    if set_bdy_tags:
+        mesh = bdy_tags_from_sort(mesh, t, f, matched, valid_s)
+    tp = t[partner]
+    fp = f[partner]
+    own_s = matched & (t < tp) & (mesh.ftag[t, f] == 0) & \
+        (mesh.ftag[tp, fp] == 0)
+    q2 = q_tet[tp]
+    is_star, _, _ = scatter_argmax2(t, -q2, -f, own_s, capT)
+    site_star = jnp.where(is_star, t, capT)
+    # ONE packed 3-column scatter for the winner fields (per-op overhead
+    # dominates scatter cost on this device)
+    pay = jnp.stack([f, tp, fp], axis=1)
+    tbl = jnp.zeros((capT, 3), jnp.int32).at[site_star].set(
+        pay, mode="drop", unique_indices=True)
+    fstar, t2_full, f2_full = tbl[:, 0], tbl[:, 1], tbl[:, 2]
+    cand_full = jnp.zeros(capT + 1, bool).at[
+        jnp.where(own_s, t, capT)].max(own_s, mode="drop")[:capT]
+    return mesh, fstar, t2_full, f2_full, cand_full
+
+
+def swap23_wave(mesh: Mesh, met: jax.Array,
+                budget_div: int = 8,
+                wwin: jax.Array | None = None,
+                facesort: bool = False,
+                set_bdy_tags: bool = True) -> SwapResult:
+    """2-to-3 swap: interior faces whose two tets improve as an edge fan.
+
+    Tets T1, T2 share interior face (p,q,r) with apexes a (in T1) and b (in
+    T2); replaced by (a,b,p,q), (a,b,q,r), (a,b,r,p) — two slots reused,
+    one allocated.
+
+    ``facesort=True`` (PARMMG_SWAP_FACESORT): derive the face-pair table
+    directly from the face-sort records (ops/adjacency.face_sort) instead
+    of requiring a ``build_adjacency`` call between swap_edges_wave and
+    this wave — the caller passes the post-edge-swap mesh as-is and
+    ``set_bdy_tags`` replays the legacy rebuild's MG_BDY tagging from the
+    same sort.  Bit-for-bit identical to the legacy sequence (see
+    _pair_fields_facesort); ``adja`` is left stale, which is sound
+    because this pairing is its only cycle-interior reader (the cycle
+    exit contract rebuilds it).
+    """
+    capT, capP = mesh.capT, mesh.capP
+    m6 = _met6(met)
     # per-tet quality once; ONE candidate face per tet — the face toward
     # the worst neighbor.  Then top-K compaction: only the K candidate
     # pairs with the WORST current quality go through the fan
@@ -500,26 +600,28 @@ def swap23_wave(mesh: Mesh, met: jax.Array,
     # exactness is unchanged, deferred candidates wait one wave)
     q_tet = quality_from_points(
         mesh.vert[mesh.tet], None if m6 is None else m6[mesh.tet])
-    q_nb = jnp.where(own, q_tet[nb_s], jnp.inf)          # [T,4]
-    fstar = jnp.argmin(q_nb, axis=1).astype(jnp.int32)   # [T]
-    arT = jnp.arange(capT)
-    t2_full = nb_s[arT, fstar]
-    cand_full = own[arT, fstar]
+    if facesort:
+        mesh, fstar, t2_full, f2_full, cand_full = _pair_fields_facesort(
+            mesh, q_tet, capT, set_bdy_tags)
+    else:
+        fstar, t2_full, f2_full, cand_full = _pair_fields_adja(
+            mesh, q_tet, capT)
     if wwin is not None:
         # spatial-window rotation (ops/active.py): see collapse_wave
         cand_full = cand_full & jnp.all(
             wwin[jnp.clip(mesh.tet, 0, capP - 1)], axis=1)
     q_pair = jnp.minimum(q_tet, jnp.where(cand_full, q_tet[t2_full],
                                           jnp.inf))
-    from .edges import wave_budget
+    from .edges import wave_budget, topk_prep
     F = min(capT, wave_budget(capT, budget_div))
-    defer = jnp.sum(cand_full.astype(jnp.int32)) > F
-    _, sel = jax.lax.top_k(jnp.where(cand_full, -q_pair, -jnp.inf), F)
+    neg, ncand = topk_prep(cand_full, q_pair)
+    defer = ncand > F
+    _, sel = jax.lax.top_k(neg, F)
     ar = jnp.arange(F)
     t1 = sel.astype(jnp.int32)
     f1 = fstar[sel]
     t2 = t2_full[sel]
-    f2 = nf_s[sel, f1]
+    f2 = f2_full[sel]
     cand = cand_full[sel]
 
     from ..core.constants import IDIR

@@ -144,7 +144,16 @@ def _group_block(flags: tuple, pres: tuple, nomove: bool,
     counts via ``lax.cond`` instead of running the wave math
     (ops/adapt.py ``active=``).  The mask is ALWAYS an argument (an
     all-true mask when masking is off), so toggling it mints zero new
-    compile families — the grouped_sched_gate contract."""
+    compile families — the grouped_sched_gate contract.
+
+    ``cadence`` (last argument of the compiled program) is the
+    smoothing-cadence enable (PARMMG_SMOOTH_CADENCE via
+    sched.cadence_enabled): like the quiet mask it is ALWAYS a traced
+    argument, so toggling it mints zero new compile families
+    (the hotloop_knob_gate contract).  The per-slot idle carry is
+    derived on-device from each cycle's counts inside the map body —
+    a cycle following a full no-op cycle skips its smoothing wave as a
+    proven identity (ops/adapt.py ``smooth_idle``)."""
     from ..ops.adapt import adapt_cycle_impl
     from ..utils.compilecache import governed
     key = (flags, pres, nomove, noinsert, hausd)
@@ -152,8 +161,9 @@ def _group_block(flags: tuple, pres: tuple, nomove: bool,
         return _GROUP_BLOCK_CACHE[key]
 
     def body(args):
-        m, k, wave, act = args
+        m, k, wave, act, cad = args
         counts_all = []
+        sm_idle = jnp.zeros((), bool)
         for cc, dosw in enumerate(flags):
             # named_scope: XLA ops of each unrolled cycle carry the
             # phase name on a profiler's device timeline (obs/trace.py)
@@ -162,7 +172,10 @@ def _group_block(flags: tuple, pres: tuple, nomove: bool,
                     m, k, wave + cc, do_swap=dosw,
                     do_smooth=not nomove, do_insert=not noinsert,
                     hausd=hausd, final_rebuild=(cc == len(flags) - 1),
-                    prescreen=pres[cc], active=act)
+                    prescreen=pres[cc], active=act,
+                    smooth_idle=cad & sm_idle)
+            sm_idle = ((counts[0] + counts[1] + counts[2]) == 0) & \
+                (counts[3] == 0)
             counts_all.append(counts)
         return m, k, jnp.stack(counts_all)       # [n, 6]
 
@@ -171,11 +184,12 @@ def _group_block(flags: tuple, pres: tuple, nomove: bool,
     # chunk to ONE shape family — growth past this is recompile churn
     @governed("groups.adapt_block", budget=6)
     @jax.jit
-    def run(stacked, met_s, wave, active):
+    def run(stacked, met_s, wave, active, cadence):
         n_map = stacked.vert.shape[0]            # chunk or g_exec
         waves = jnp.full(n_map, wave, jnp.int32)
+        cads = jnp.full(n_map, cadence, bool)
         m, k, counts = jax.lax.map(body,
-                                   (stacked, met_s, waves, active))
+                                   (stacked, met_s, waves, active, cads))
         return m, k, counts                      # counts [G, n, 6]
 
     _GROUP_BLOCK_CACHE[key] = run
@@ -227,8 +241,13 @@ def _pad_groups(tree, g_new: int):
     return jax.tree.map(pad, tree)
 
 
-def _pipeline_chunks(fn, stacked, met_s, wave, plans, tim, done=None):
+def _pipeline_chunks(fn, stacked, met_s, wave, plans, tim, done=None,
+                     extra=()):
     """Double-buffered chunked dispatch over gathered group-index slices.
+
+    ``extra``: additional positional device scalars appended to each
+    ``fn`` dispatch after the active mask (the adapt block's traced
+    cadence enable; empty for the polish block).
 
     ``plans``: [(idx_exec [chunk], nreal)] from the quiet-group
     scheduler (parallel/sched.py); the SAME compiled [chunk, ...]
@@ -299,7 +318,7 @@ def _pipeline_chunks(fn, stacked, met_s, wave, plans, tim, done=None):
             act = jnp.asarray(pad_mask(len(idx), nreal))
         faultpoint("dispatch.chunk", key=str(pi))
         with otrace.annotate(f"grp_dispatch_chunk{pi}"):
-            m, k, cnt = fn(sl, kl, wave, act)
+            m, k, cnt = fn(sl, kl, wave, act, *extra)
         return (pi, idx, nreal, m, k, cnt)
 
     # lint: ok(R2) — the pipeline's ONE designed sync point: chunked
@@ -459,6 +478,11 @@ def grouped_adapt_pass(mesh: Mesh, met, ngroups: int, cycles: int = 12,
         jax.tree.map(w, dst_tree, src_tree)
 
     sched = QuietGroupScheduler(ngroups, g_exec, chunk)
+    # smoothing-cadence enable as a DEVICE SCALAR: always an argument
+    # of the compiled block (like the quiet mask), so toggling
+    # PARMMG_SMOOTH_CADENCE mints zero new compile families
+    from .sched import cadence_enabled
+    cad = jnp.asarray(cadence_enabled())
     # pipeline segment timers on a LOCAL registry: folded into
     # stats.sched_extra and (prefixed) into the caller's Timers at the
     # end, so the driver report shows the transfer/compute split
@@ -477,7 +501,7 @@ def grouped_adapt_pass(mesh: Mesh, met, ngroups: int, cycles: int = 12,
         with otrace.context(block=c, chunk=chunk or 0):
             if chunk:
                 parts = _pipeline_chunks(step, stacked, met_s, wave,
-                                         plans, ltim)
+                                         plans, ltim, extra=(cad,))
                 sched.note_plan_pads(plans)
                 counts_act = np.concatenate(parts) if parts else \
                     np.zeros((0, nblk, 8), np.int32)
@@ -493,24 +517,28 @@ def grouped_adapt_pass(mesh: Mesh, met, ngroups: int, cycles: int = 12,
                 # sched.block_mask; bit-for-bit by the fixed point)
                 stacked, met_s, counts = step(
                     stacked, met_s, wave,
-                    jnp.asarray(sched.block_mask(pres_all_on)))
+                    jnp.asarray(sched.block_mask(pres_all_on)), cad)
                 counts_act = np.asarray(counts)  # [g_exec, nblk, 8]
         sched.record_block(act, counts_act, swap_inc, pres_all_on)
         # quiet groups contribute exact zeros (that is what marked them)
         cs = counts_act.sum(axis=0, dtype=np.int64)     # [nblk, 8]
+        # ONE host conversion for the whole block's counters (counts_act
+        # is already host numpy — the drain pulled it); the per-counter
+        # int() casts were R2-baselined noise
+        cs_l = cs.tolist()                              # python ints
         for i in range(nblk):
-            tot = cs[i]
+            tot = cs_l[i]
             if stats is not None:
-                stats.nsplit += int(tot[0])
-                stats.ncollapse += int(tot[1])
-                stats.nswap += int(tot[2])
-                stats.nmoved += int(tot[3])
+                stats.nsplit += tot[0]
+                stats.ncollapse += tot[1]
+                stats.nswap += tot[2]
+                stats.nmoved += tot[3]
                 stats.cycles += 1
             otrace.log(3, f"  grp cycle {c + i}: split {tot[0]} "
                           f"collapse {tot[1]} swap {tot[2]} move "
                           f"{tot[3]} over {ngroups} groups",
                        verbose=verbose)
-        if int(cs[:, 4].max()) != 0:
+        if any(row[4] != 0 for row in cs_l):
             if regrows >= 6:
                 raise MemoryError("group capacity exhausted")
             capP = stacked.vert.shape[1]
@@ -694,10 +722,10 @@ def grouped_adapt_pass(mesh: Mesh, met, ngroups: int, cycles: int = 12,
                     sched.note_plan_pads(plans)
                     cnts = np.concatenate(parts)      # [n_act, 4]
                     pol_traj.append(len(pol_act))
-                    tot = cnts.sum(axis=0, dtype=np.int64)
+                    tot = cnts.sum(axis=0, dtype=np.int64).tolist()
                     otrace.log(2, f"  grp polish w{w}: collapse "
-                                  f"{int(tot[0])} swap {int(tot[1])} "
-                                  f"move {int(tot[2])} over "
+                                  f"{tot[0]} swap {tot[1]} "
+                                  f"move {tot[2]} over "
                                   f"{len(pol_act)} active groups",
                                verbose=verbose)
                     pol_act = pol_act[(cnts[:, 0] + cnts[:, 1]) > 0]
@@ -728,12 +756,15 @@ def grouped_adapt_pass(mesh: Mesh, met, ngroups: int, cycles: int = 12,
                     sl, kl, cnt = polish_block(
                         sl, kl, jnp.asarray(2000 + w, jnp.int32),
                         jnp.ones(chunk, bool))
-                    tot = np.asarray(cnt).sum(axis=0)
+                    # one host pull for the chunk's counters (the
+                    # legacy loop's designed sync point), python ints
+                    # from it without per-counter casts
+                    tot = np.asarray(cnt).sum(axis=0).tolist()
                     otrace.log(2, f"  grp polish chunk {g0 // chunk} "
-                                  f"w{w}: collapse {int(tot[0])} swap "
-                                  f"{int(tot[1])} move {int(tot[2])}",
+                                  f"w{w}: collapse {tot[0]} swap "
+                                  f"{tot[1]} move {tot[2]}",
                                verbose=verbose)
-                    if int(tot[0]) == 0 and int(tot[1]) == 0:
+                    if tot[0] == 0 and tot[1] == 0:
                         break
                 _assign(stacked, sl, g0)
                 met_s[g0:g0 + chunk] = np.asarray(kl)
@@ -742,11 +773,11 @@ def grouped_adapt_pass(mesh: Mesh, met, ngroups: int, cycles: int = 12,
                 stacked, met_s, cnt = polish_block(
                     stacked, met_s, jnp.asarray(2000 + w, jnp.int32),
                     jnp.ones(g_exec, bool))
-                tot = np.asarray(cnt).sum(axis=0)
+                tot = np.asarray(cnt).sum(axis=0).tolist()
                 otrace.log(2, f"  grp polish {w}: collapse "
-                              f"{int(tot[0])} swap {int(tot[1])} move "
-                              f"{int(tot[2])}", verbose=verbose)
-                if int(tot[0]) == 0 and int(tot[1]) == 0:
+                              f"{tot[0]} swap {tot[1]} move "
+                              f"{tot[2]}", verbose=verbose)
+                if tot[0] == 0 and tot[1] == 0:
                     break
     # fold the scheduler instrumentation: counters + the active-group
     # trajectory into AdaptStats.sched_extra (bench/SCALE artifacts),

@@ -101,6 +101,21 @@ def device_mask_enabled() -> bool:
     return os.environ.get("PARMMG_DEVICE_MASK", "1") != "0"
 
 
+def cadence_enabled() -> bool:
+    """PARMMG_SMOOTH_CADENCE knob (default on): quality-triggered
+    smoothing cadence — adapt_cycle_impl skips ``smooth_wave`` on a
+    cycle whose topology counts are all zero AND whose previous cycle's
+    smoothing already moved nothing (an exact fixed point: the claim
+    resolution in smooth_wave guarantees nmoved==0 iff no vertex can
+    improve, and that emptiness is wave-rotation-invariant; see the
+    adapt_cycle_impl docstring for the full argument).  The enable is
+    threaded as a TRACED device scalar (like the quiet mask), so
+    toggling it mints zero new ``groups.*`` compile families —
+    asserted by the ``run_tests.sh --ledger`` hotloop_knob_gate."""
+    import os
+    return os.environ.get("PARMMG_SMOOTH_CADENCE", "") != "0"
+
+
 def pad_mask(chunk: int, nreal: int) -> np.ndarray:
     """[chunk] bool device-mask for a compacted chunk plan: the first
     ``nreal`` rows are real, the repeat-padded tail rows are masked off

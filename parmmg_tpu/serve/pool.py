@@ -383,17 +383,22 @@ class SlotPool:
         into ``done``)."""
         from ..obs import trace as otrace
         from ..obs.metrics import REGISTRY
+        import jax.numpy as jnp
         from ..parallel.groups import _pipeline_chunks
-        from ..parallel.sched import chunk_plans
+        from ..parallel.sched import cadence_enabled, chunk_plans
         from ..resilience.faults import FAULTS, faultpoint
         plans = chunk_plans(np.asarray(ids), self.chunk)
+        # smoothing-cadence enable rides along as a traced scalar (the
+        # hotloop_knob_gate contract): same compiled programs either way
+        cad = jnp.asarray(cadence_enabled())
         committed: dict = {}
         try:
             if FAULTS.armed():
                 for i in ids:
                     faultpoint("serve.slot_step", key=b.slots[i].tenant)
             parts = _pipeline_chunks(fn, b.stacked, b.met, wave, plans,
-                                     self.timers, done=committed)
+                                     self.timers, done=committed,
+                                     extra=(cad,))
             self.dispatches += len(plans)
             REGISTRY.counter("serve.dispatches").inc(len(plans))
             return list(zip(ids, np.concatenate(parts)))
@@ -417,7 +422,8 @@ class SlotPool:
                     faultpoint("serve.slot_step", key=s.tenant)
                     plans1 = chunk_plans(np.asarray([i]), self.chunk)
                     parts1 = _pipeline_chunks(fn, b.stacked, b.met,
-                                              wave, plans1, self.timers)
+                                              wave, plans1, self.timers,
+                                              extra=(cad,))
                     self.dispatches += len(plans1)
                     REGISTRY.counter("serve.dispatches").inc(len(plans1))
                     out.append((i, np.concatenate(parts1)[0]))

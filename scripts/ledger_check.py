@@ -160,6 +160,80 @@ def grouped_sched_gate() -> int:
     return 0
 
 
+def hotloop_knob_gate() -> int:
+    """Hot-loop knob compile-family gate (the cycle-cost demolition
+    attacks, README "Hot-loop cycle costs"): flipping the smoothing
+    cadence, the facesort swap pairing, the donor-band collapse apply
+    or the Pallas scoring prep may not mint a single new ``groups.*``
+    compile family in a warm process.  Two distinct mechanisms back
+    this: PARMMG_SMOOTH_CADENCE is a TRACED device scalar of the
+    compiled block (like the quiet mask — toggling changes an input
+    value, never the program), while the facesort / band / score knobs
+    are trace-time reads whose both settings produce bit-identical
+    results, so the warm ``_GROUP_BLOCK_CACHE`` program from the first
+    run legitimately serves the flipped runs (a stale entry is only a
+    perf choice, never a correctness one)."""
+    import jax.numpy as jnp
+    from parmmg_tpu.core.mesh import make_mesh
+    from parmmg_tpu.ops.analysis import analyze_mesh
+    from parmmg_tpu.parallel.groups import grouped_adapt_pass
+    from parmmg_tpu.utils.compilecache import (ledger_violations,
+                                               reset_ledger,
+                                               variants_by_prefix)
+    from parmmg_tpu.utils.fixtures import cube_mesh
+
+    KNOBS = ("PARMMG_SMOOTH_CADENCE", "PARMMG_SWAP_FACESORT",
+             "PARMMG_COLLAPSE_BAND", "PARMMG_PALLAS_SCORE")
+
+    def run(setting: str):
+        for k in KNOBS:
+            os.environ[k] = setting
+        # cube(4): a capacity rung no earlier gate in this process has
+        # compiled, so the knobs-off run below really compiles the
+        # family (variants only count at compile time — a warm-cache
+        # run would leave v0 empty and make the comparison vacuous)
+        vert, tet = cube_mesh(4)
+        m = make_mesh(vert, tet, capP=4 * len(vert), capT=4 * len(tet))
+        m = analyze_mesh(m).mesh
+        met = jnp.full(m.capP, 0.35, m.vert.dtype)
+        out, _, _ = grouped_adapt_pass(m, met, 3, cycles=2)
+        assert int(np.asarray(out.tmask).sum()) > 0
+
+    prev = {k: os.environ.get(k)
+            for k in KNOBS + ("PARMMG_GROUP_CHUNK",)}
+    os.environ["PARMMG_GROUP_CHUNK"] = "1"
+    try:
+        reset_ledger()
+        run("0")                      # all attacks off (legacy paths)
+        v0 = variants_by_prefix("groups.")
+        run("1")                      # all attacks on
+        v1 = variants_by_prefix("groups.")
+    finally:
+        for k, v in prev.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    assert v0.get("groups.adapt_block", 0) >= 1, \
+        "hot-loop knob scenario no longer exercises groups.adapt_block"
+    print("--- hot-loop knob scenario (cadence/facesort/band/score)")
+    if v1 != v0:
+        print("HOT-LOOP KNOB COMPILE-FAMILY REGRESSIONS (knobs-on run "
+              f"added variants vs knobs-off): {v0} -> {v1}",
+              file=sys.stderr)
+        return 1
+    bad = ledger_violations()
+    if bad:
+        print("\nLEDGER BUDGET VIOLATIONS (hot-loop knobs):",
+              file=sys.stderr)
+        for v in bad:
+            print(f"  {v}", file=sys.stderr)
+        return 1
+    print(f"hot-loop knobs OK: zero new compile families ({v1}; "
+          "cadence, facesort, collapse band, pallas score)")
+    return 0
+
+
 def serving_gate() -> int:
     """Serving compile-family gate: a warm pool serving tenants of two
     DIFFERENT bucket sizes must add ZERO ``groups.*`` compile-ledger
@@ -328,6 +402,10 @@ def main() -> int:
     # quiet-group scheduler gate: compaction must reuse the compiled
     # [chunk, ...] group program — zero new families with it enabled
     rc = max(rc, grouped_sched_gate())
+    # hot-loop knob gate: cadence/facesort/band/score toggles add zero
+    # groups.* families in a warm process (traced-scalar + warm-cache
+    # contracts — see hotloop_knob_gate)
+    rc = max(rc, hotloop_knob_gate())
     # serving gate: a warm multi-tenant pool adds zero groups.*
     # families vs the batch grouped path (and matches it bit-for-bit)
     rc = max(rc, serving_gate())

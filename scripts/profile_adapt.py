@@ -112,6 +112,21 @@ def main():
     timeit("boundary_edge_tags", adj.boundary_edge_tags, mesh)
     timeit("swap32_wave", lambda m, k: swap32_wave(m, k), mesh, met)
     timeit("swap23_wave", lambda m, k: swap23_wave(m, k), mesh, met)
+    # hot-loop attack segments (README "Cycle-cost demolition"): STABLE
+    # phase names — BENCH rounds diff these across sessions, keep them.
+    # swap_face_pairs: the face-sort records swap23 pairs off when
+    # PARMMG_SWAP_FACESORT is on (vs build_adjacency + swap23_wave)
+    timeit("swap_face_pairs", adj.face_sort, mesh)
+    timeit("swap23_facesort",
+           lambda m, k: swap23_wave(m, k, facesort=True), mesh, met)
+    # collapse_wave_fullwidth: the PARMMG_COLLAPSE_BAND=0 arm — the
+    # donor-band saving is (collapse_wave_fullwidth - collapse_wave)
+    os.environ["PARMMG_COLLAPSE_BAND"] = "0"
+    try:
+        timeit("collapse_wave_fullwidth",
+               lambda m, k: collapse_wave(m, k), mesh, met)
+    finally:
+        del os.environ["PARMMG_COLLAPSE_BAND"]
     timeit("smooth_wave", lambda m, k: smooth_wave(m, k), mesh, met)
 
     # full cycles, as bench runs them.  adapt_cycle DONATES its inputs, so
