@@ -300,6 +300,7 @@ def main() -> None:
     # real grouped workload.
     group_sched = None
     parity_ok = None
+    incr_topo = None
     if os.environ.get("BENCH_GROUPED", "0") == "1":
         from parmmg_tpu.core.mesh import MESH_FIELDS
         from parmmg_tpu.ops.adapt import AdaptStats
@@ -308,7 +309,8 @@ def main() -> None:
         ngr = 3
         cycles_g = int(os.environ.get("BENCH_GROUPED_CYCLES", "12"))
         prev_env = {k: os.environ.get(k)
-                    for k in ("PARMMG_GROUP_CHUNK", "PARMMG_DEVICE_MASK")}
+                    for k in ("PARMMG_GROUP_CHUNK", "PARMMG_DEVICE_MASK",
+                              "PARMMG_INCR_TOPO")}
         os.environ["PARMMG_GROUP_CHUNK"] = "0"
         # x-slab groups on the shock metric, with the far field CLAMPED
         # into the metric dead band (h <= 1.3/n: edges stay inside
@@ -370,6 +372,34 @@ def main() -> None:
             os.environ["PARMMG_GROUP_CHUNK"] = "2"
             _, _, st2, _ = run_grouped("1")
             os.environ["PARMMG_GROUP_CHUNK"] = "0"
+            # incremental-topology A/B (PARMMG_INCR_TOPO, ops/topo_incr):
+            # the SAME mask-on pass re-runs with the knob on — a traced
+            # scalar, so it rides the compiled programs already warmed
+            # above (ledger_check.py --diff shows zero groups.* growth).
+            # The knob-off arm IS the mask-on run (t_on); outputs AND op
+            # counters must be bit-identical (exactness by construction:
+            # the dirty band re-keys exactly the slots whose keys could
+            # have changed, overflow falls back to the full rebuild)
+            os.environ["PARMMG_INCR_TOPO"] = "1"
+            inc_g, kinc_g, st3, t_inc = run_grouped("1", reps=3)
+            os.environ.pop("PARMMG_INCR_TOPO", None)
+            incr_parity = bool(
+                all((np.asarray(getattr(chk_g, f))
+                     == np.asarray(getattr(inc_g, f))).all()
+                    for f in MESH_FIELDS)
+                and (np.asarray(kchk_g) == np.asarray(kinc_g)).all()
+                and (st3.nsplit, st3.ncollapse, st3.nswap, st3.nmoved)
+                == (st1.nsplit, st1.ncollapse, st1.nswap, st1.nmoved))
+            incr_topo = {
+                "off_s_per_cycle": round(t_on / max(st1.cycles, 1), 4),
+                "on_s_per_cycle": round(t_inc / max(st3.cycles, 1), 4),
+                "speedup": round(t_on / t_inc, 3),
+                "parity_ok": incr_parity,
+                # per-cycle dirty-tet counts (band occupancy the merge
+                # absorbed; > band width = full-rebuild fallback cycles)
+                "dirty_per_cycle":
+                    st3.sched_extra.get("incr_dirty_per_cycle", []),
+            }
             group_sched = {
                 "ngroups": ngr,
                 "cycles": st1.cycles,
@@ -443,6 +473,10 @@ def main() -> None:
                "extract1x_s": extract1x_s,
                "group_sched": group_sched,
                "parity_ok": parity_ok,
+               # incremental-topology A/B (BENCH_GROUPED=1): same-machine
+               # s/cycle with PARMMG_INCR_TOPO off vs on + dirty-band
+               # trajectory; outputs bit-identical (parity_ok)
+               "incr_topo": incr_topo,
                "profile_phases": profile_phases,
                "device": str(jax.devices()[0].platform),
                "fallback": os.environ.get(

@@ -130,6 +130,20 @@ KNOBS: dict[str, Knob] = {
         "flag", "",
         "1 = skip the device analysis-refresh path and always use the "
         "host fallback"),
+    "PARMMG_INCR_BAND": Knob(
+        "int", "",
+        "override the incremental-topology dirty-band width in tets "
+        "(ops/topo_incr.incr_band_width; tests/tuning); empty = one "
+        "geo-ladder rung of capT//16, floor 1024"),
+    "PARMMG_INCR_TOPO": Knob(
+        "flag", "",
+        "incremental topology maintenance: merge each wave's dirty-tet "
+        "band into the retained sorted edge/face tables instead of "
+        "re-sorting all 6*capT/4*capT slot keys per derivation "
+        "(ops/topo_incr.py; overflow lax.cond-falls back to the full "
+        "rebuild, bit-identical by the stable-sort merge proof); "
+        "threaded as a traced scalar so toggling mints zero compile "
+        "families; 0/unset = legacy full rebuilds"),
     "PARMMG_MH_CACHE_DIR": Knob(
         "path", "",
         "shared persistent compile-cache dir for multi-host pod "

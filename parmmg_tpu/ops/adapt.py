@@ -106,7 +106,7 @@ def adapt_cycle_impl(mesh: Mesh, met: jax.Array, wave: jax.Array,
                      et0=None, vact=None, submesh: bool = False,
                      wide: bool = False, wwin=None,
                      prescreen: bool = True, active=None,
-                     smooth_idle=None):
+                     smooth_idle=None, topo=None, incr=None):
     """One adaptation cycle: split -> collapse -> [swap] -> [smooth].
 
     Pure jittable function (jitted wrapper below) — also the compile-check
@@ -168,27 +168,56 @@ def adapt_cycle_impl(mesh: Mesh, met: jax.Array, wave: jax.Array,
     Like ``active``, it is a TRACED argument: toggling the cadence
     never mints a new compile family.  Only used on the full-width path
     (callers pass None alongside vact/wwin restrictions).
+
+    ``topo``/``incr``: the incremental topology engine (ops/topo_incr).
+    ``topo`` is a TopoState carrying the retained edge/face sorts and
+    dirty masks across cycles; ``incr`` the traced PARMMG_INCR_TOPO
+    scalar.  When threaded, the cycle derives its edge table and
+    adjacency through the band-merge path (bit-identical to the legacy
+    rebuilds — off position, overflow and cold state all take the exact
+    full sort), marks the tets each wave touched (unconditionally, so
+    both knob arms report identical counts), the counts row widens to 9
+    (``counts[8]`` = dirty tets at cycle start), and the return becomes
+    a 4-tuple ``(mesh, met, counts, topo)``.  ``topo=None`` is the
+    untouched legacy path (8-wide counts, 3-tuple).
     """
     from .adjacency import boundary_edge_tags
+    if topo is not None:
+        from .topo_incr import (incr_unique_edges, incr_build_adjacency,
+                                mark_dirty)
+        if incr is None:
+            incr = jnp.zeros((), bool)
     if active is not None:
         def _run(ops):
-            m, k = ops
-            return adapt_cycle_impl(
+            m, k, tp = ops
+            out = adapt_cycle_impl(
                 m, k, wave, do_swap=do_swap, do_smooth=do_smooth,
                 smooth_waves=smooth_waves, do_insert=do_insert,
                 final_rebuild=final_rebuild, hausd=hausd,
                 budget_div=budget_div, et0=et0, vact=vact,
                 submesh=submesh, wide=wide, wwin=wwin,
-                prescreen=prescreen, smooth_idle=smooth_idle)
+                prescreen=prescreen, smooth_idle=smooth_idle,
+                topo=tp, incr=incr)
+            return out if tp is not None else out + (tp,)
 
         def _skip(ops):
-            m, k = ops
-            counts = jnp.zeros(8, jnp.int32).at[5].set(
+            m, k, tp = ops
+            nc = 8 if tp is None else 9
+            counts = jnp.zeros(nc, jnp.int32).at[5].set(
                 jnp.sum(m.tmask, dtype=jnp.int32))
-            return m, k, counts
-        return jax.lax.cond(active, _run, _skip, (mesh, met))
+            if tp is not None:
+                # an idle slot's retained tables stay valid; report its
+                # pending dirty count for the occupancy trajectory
+                counts = counts.at[8].set(
+                    jnp.sum(tp.edirty, dtype=jnp.int32))
+            return m, k, counts, tp
+        m, k, counts, tp = jax.lax.cond(active, _run, _skip,
+                                        (mesh, met, topo))
+        return (m, k, counts) if topo is None else (m, k, counts, tp)
     defer = jnp.zeros((), bool)
     defer_sw = jnp.zeros((), bool)
+    nd0 = (jnp.zeros((), jnp.int32) if topo is None
+           else jnp.sum(topo.edirty, dtype=jnp.int32))
     if do_insert:
         # ONE edge table + metric lengths serve both split and collapse
         # (the tables are a measured wave hot spot); the collapse defers
@@ -201,7 +230,11 @@ def adapt_cycle_impl(mesh: Mesh, met: jax.Array, wave: jax.Array,
         # cycle — smoothing only moves vertices, so the table is
         # provably identical; metric lengths ALWAYS recompute).
         if et0 is None:
-            et0 = unique_edges(mesh, shell_slots=0)
+            if topo is not None:
+                et0, topo = incr_unique_edges(mesh, topo, incr,
+                                              shell_slots=0)
+            else:
+                et0 = unique_edges(mesh, shell_slots=0)
         lens0 = edge_lengths(mesh, et0, met)
         # ridge tangents once per cycle too (same sharing rationale;
         # collapse only consults non-stale candidates, whose tangent
@@ -217,6 +250,8 @@ def adapt_cycle_impl(mesh: Mesh, met: jax.Array, wave: jax.Array,
         res = split_wave(mesh, met, hausd=hausd, budget_div=budget_div,
                          et=et0, lens=lens0, vtan=vtan0, vact=vact,
                          prescreen=prescreen and not wide)
+        if topo is not None:
+            topo = mark_dirty(topo, mesh.tet, mesh.tmask, res.mesh)
         mesh, met = res.mesh, res.met
         nsplit, overflow = res.nsplit, res.overflow
         defer = defer | res.deferred
@@ -226,6 +261,11 @@ def adapt_cycle_impl(mesh: Mesh, met: jax.Array, wave: jax.Array,
                             et=et0, lens=lens0,
                             stale_tets=res.modified, vtan=vtan0,
                             vact=vact, wwin=wwin)
+        if topo is not None:
+            # boundary_edge_tags below touches only tags, which the
+            # retained sorts never carry — marking against col.mesh is
+            # exact (ops/topo_incr module docstring)
+            topo = mark_dirty(topo, mesh.tet, mesh.tmask, col.mesh)
         defer = defer | col.deferred
         # collapse rewires the surface (dying tets' face tags transfer to
         # the surviving neighbors); re-propagate MG_BDY from faces to
@@ -249,19 +289,31 @@ def adapt_cycle_impl(mesh: Mesh, met: jax.Array, wave: jax.Array,
         sew = swap_edges_wave(mesh, met, hausd=hausd,
                               budget_div=budget_div,
                               vact=vact, wwin=wwin)  # 3-2 + 2-2
+        if topo is not None:
+            topo = mark_dirty(topo, mesh.tet, mesh.tmask, sew.mesh)
         if swap_facesort_enabled():
             # swap23 pairs directly off the face sort (bit-identical to
             # the adja path — ops/swap._pair_fields_facesort); the
             # [capT,4] adja materialization + compare leaves the cycle
-            # interior, final_rebuild restores the adja contract
+            # interior, final_rebuild restores the adja contract.
+            # (This mid-cycle face sort is NOT band-maintained — scope
+            # cut: the facesort swap23 derives its pairing internally.)
             s23 = swap23_wave(sew.mesh, met, budget_div=budget_div,
                               wwin=wwin, facesort=True,
                               set_bdy_tags=not submesh)
+            pre = sew.mesh
         else:
             # consumed by swap23 (adja-only on a sub-mesh: cut faces are
             # unmatched without being surface)
-            mesh = build_adjacency(sew.mesh, set_bdy_tags=not submesh)
+            if topo is not None:
+                mesh, topo = incr_build_adjacency(
+                    sew.mesh, topo, incr, set_bdy_tags=not submesh)
+            else:
+                mesh = build_adjacency(sew.mesh, set_bdy_tags=not submesh)
             s23 = swap23_wave(mesh, met, budget_div=budget_div, wwin=wwin)
+            pre = mesh
+        if topo is not None:
+            topo = mark_dirty(topo, pre.tet, pre.tmask, s23.mesh)
         mesh = s23.mesh
         nswap = sew.nswap + s23.nswap
         defer_sw = defer_sw | sew.deferred | s23.deferred
@@ -294,15 +346,22 @@ def adapt_cycle_impl(mesh: Mesh, met: jax.Array, wave: jax.Array,
             mesh, nmoved = _smooth(mesh)
 
     if final_rebuild:
-        mesh = build_adjacency(mesh, set_bdy_tags=not submesh)
+        if topo is not None:
+            mesh, topo = incr_build_adjacency(mesh, topo, incr,
+                                              set_bdy_tags=not submesh)
+        else:
+            mesh = build_adjacency(mesh, set_bdy_tags=not submesh)
 
-    counts = jnp.stack([nsplit, ncol, nswap, nmoved,
-                        overflow.astype(jnp.int32),
-                        jnp.sum(mesh.tmask, dtype=jnp.int32),
-                        defer.astype(jnp.int32)
-                        + 2 * defer_sw.astype(jnp.int32),
-                        jnp.zeros((), jnp.int32)])
-    return mesh, met, counts
+    row = [nsplit, ncol, nswap, nmoved,
+           overflow.astype(jnp.int32),
+           jnp.sum(mesh.tmask, dtype=jnp.int32),
+           defer.astype(jnp.int32) + 2 * defer_sw.astype(jnp.int32),
+           jnp.zeros((), jnp.int32)]
+    if topo is None:
+        return mesh, met, jnp.stack(row)
+    # counts[8]: dirty tets pending at cycle START — the dirty-band
+    # occupancy trajectory the grouped drivers surface in sched_extra
+    return mesh, met, jnp.stack(row + [nd0]), topo
 
 
 from ..utils.compilecache import governed as _governed  # noqa: E402
@@ -342,7 +401,7 @@ def adapt_cycles_fused_impl(mesh: Mesh, met: jax.Array, wave0: jax.Array,
                             do_smooth: bool = True,
                             do_insert: bool = True,
                             budget_div: int = 8,
-                            cadence=None):
+                            cadence=None, topo=None, incr=None):
     """``n_cycles`` adaptation cycles in ONE jitted program.
 
     On a remote-attached TPU every dispatch pays a transport round trip
@@ -365,6 +424,13 @@ def adapt_cycles_fused_impl(mesh: Mesh, met: jax.Array, wave0: jax.Array,
     cycle's smoothing wave is skipped as a proven identity (see
     adapt_cycle_impl's ``smooth_idle``).  The carry is derived on-device
     from each cycle's counts, so the cadence costs no extra transfer.
+
+    ``topo``/``incr``: thread the incremental topology engine through
+    the block (see adapt_cycle_impl) — the retained table + band state
+    is the carry, superseding the all-or-nothing et cache below (the
+    engine's nd==0 branch reuses the retained sort wholesale, covering
+    the same topo-quiet case AND extending it to adjacency).  Returns a
+    4-tuple ``(mesh, met, counts [n,9], topo)`` when threaded.
     """
     if swap_flags is None:
         swap_flags = tuple(
@@ -381,7 +447,7 @@ def adapt_cycles_fused_impl(mesh: Mesh, met: jax.Array, wave0: jax.Array,
     sm_idle = None if cadence is None else jnp.zeros((), bool)
     for c, dosw in enumerate(swap_flags):
         et_c = None
-        if do_insert:
+        if do_insert and topo is None:
             if prev_et is None:
                 et_c = unique_edges(mesh, shell_slots=0)
             else:
@@ -393,20 +459,27 @@ def adapt_cycles_fused_impl(mesh: Mesh, met: jax.Array, wave0: jax.Array,
                 def _rebuild(_, m=mesh):
                     return unique_edges(m, shell_slots=0)
                 et_c = jax.lax.cond(prev_ok, _reuse, _rebuild, None)
-        mesh, met, counts = adapt_cycle_impl(
+        out = adapt_cycle_impl(
             mesh, met, wave0 + c, do_swap=dosw,
             do_smooth=do_smooth, do_insert=do_insert,
             final_rebuild=(c == len(swap_flags) - 1), hausd=hausd,
             budget_div=budget_div, et0=et_c,
-            smooth_idle=None if sm_idle is None else (cadence & sm_idle))
+            smooth_idle=None if sm_idle is None else (cadence & sm_idle),
+            topo=topo, incr=incr)
+        if topo is None:
+            mesh, met, counts = out
+        else:
+            mesh, met, counts, topo = out
         counts_all.append(counts)
         if sm_idle is not None:
             sm_idle = ((counts[0] + counts[1] + counts[2]) == 0) & \
                 (counts[3] == 0)
-        if do_insert:
+        if do_insert and topo is None:
             prev_et = et_c
             prev_ok = (counts[0] + counts[1] + counts[2]) == 0
-    return mesh, met, jnp.stack(counts_all)
+    if topo is None:
+        return mesh, met, jnp.stack(counts_all)
+    return mesh, met, jnp.stack(counts_all), topo
 
 
 adapt_cycles_fused = _governed("adapt.cycles_fused")(

@@ -58,13 +58,36 @@ def face_sort(mesh: Mesh):
         invalid = cols[:, 0] == big
         w = jnp.where(invalid, big, cols[:, 1] * mesh.capP + cols[:, 2])
         order = jnp.lexsort((w, cols[:, 0]))
-        k = jnp.stack([cols[order, 0], w[order]], axis=1)
-    else:
-        order = jnp.lexsort((cols[:, 2], cols[:, 1], cols[:, 0]))
-        k = cols[order]
+        return face_records_from_sorted(mesh, order, cols[order, 0],
+                                        w[order])
+    order = jnp.lexsort((cols[:, 2], cols[:, 1], cols[:, 0]))
+    k = cols[order]
     t = tetid[order]
     f = faceid[order]
+    return _pair_records(capT, k, t, f, big)
 
+
+def face_records_from_sorted(mesh: Mesh, order: jax.Array,
+                             k0: jax.Array, kw: jax.Array):
+    """``face_sort``'s record tuple from a precomputed PACKED face sort:
+    ``order`` is the stable sort permutation over the 4*capT face slots,
+    ``k0``/``kw`` the ascending (major vertex, packed minor pair) key
+    columns — exactly what the packed lexsort produces.  Factored so the
+    incremental path (ops/topo_incr) feeds its band-merged sort through
+    the SAME twin-pairing epilogue.  ``t = order // 4`` / ``f = order %
+    4`` reproduce the tetid/faceid gathers bit-for-bit (slot layout:
+    tet-major).  Requires ``capP <= PACK_LIMIT``."""
+    big = jnp.iinfo(jnp.int32).max
+    k = jnp.stack([k0, kw], axis=1)
+    order = order.astype(jnp.int32)
+    t = order // 4
+    f = order % 4
+    return _pair_records(mesh.capT, k, t, f, big)
+
+
+def _pair_records(capT: int, k, t, f, big):
+    """Twin pairing over sorted face keys (shared epilogue): matched
+    twins are adjacent in sorted order."""
     eq_next = jnp.all(k[1:] == k[:-1], axis=1) & (k[:-1, 0] != big)
     same_next = jnp.concatenate([eq_next, jnp.array([False])])
     same_prev = jnp.concatenate([jnp.array([False]), eq_next])
@@ -101,8 +124,17 @@ def build_adjacency(mesh: Mesh, set_bdy_tags: bool = True) -> Mesh:
     unmatched without being boundary — tagging them MG_BDY would corrupt
     the surface, while adja=-1 correctly excludes them from swap23.
     """
-    capT = mesh.capT
     t, f, partner, matched, _ = face_sort(mesh)
+    return adjacency_from_records(mesh, t, f, partner, matched,
+                                  set_bdy_tags=set_bdy_tags)
+
+
+def adjacency_from_records(mesh: Mesh, t, f, partner, matched,
+                           set_bdy_tags: bool = True) -> Mesh:
+    """``build_adjacency``'s scatter epilogue from face-sort records —
+    shared with the incremental path (ops/topo_incr), which feeds it
+    band-merged records."""
+    capT = mesh.capT
     adj_val = jnp.where(matched, 4 * t[partner] + f[partner], -1)
 
     adja = jnp.full((capT, 4), -1, jnp.int32)

@@ -148,6 +148,33 @@ def unique_edges(mesh: Mesh, shell_slots: int = 3) -> EdgeTable:
     b = jnp.maximum(ev[:, 0], ev[:, 1])
     valid = jnp.repeat(mesh.tmask, 6)
     order, ka, kb, first = sort_pairs(a, b, valid, mesh.capP)
+    return _edges_epilogue(mesh, order, ka, kb, first, shell_slots)
+
+
+def unique_edges_from_sorted(mesh: Mesh, order: jax.Array, ks: jax.Array,
+                             shell_slots: int = 0) -> EdgeTable:
+    """EdgeTable from a precomputed PACKED edge sort: ``order`` is the
+    stable sort permutation over the 6*capT slot keys and ``ks`` the
+    ascending packed keys (a*capP+b, INT32_MAX on invalid slots) —
+    exactly what ``sort_pairs``' packed branch produces.  This is the
+    epilogue of :func:`unique_edges` factored out so the incremental
+    path (ops/topo_incr) can feed a band-merged sort through the SAME
+    code: tag payloads are re-gathered from the CURRENT mesh here, so
+    the retained state never carries tags.  Requires
+    ``capP <= PACK_LIMIT``."""
+    first = jnp.concatenate([jnp.array([True]), ks[1:] != ks[:-1]])
+    inv = ks == _INT32_MAX
+    ka = jnp.where(inv, _INT32_MAX, ks // mesh.capP)
+    kb = jnp.where(inv, _INT32_MAX, ks % mesh.capP)
+    return _edges_epilogue(mesh, order, ka, kb, first, shell_slots)
+
+
+def _edges_epilogue(mesh: Mesh, order, ka, kb, first,
+                    shell_slots: int) -> EdgeTable:
+    """Shared unique_edges epilogue: segment scan + scatters from the
+    sorted key columns (bit-neutral factoring of the original body)."""
+    capT = mesh.capT
+    n6 = capT * 6
     valid_s = ka != _INT32_MAX          # sorted-order validity, no gather
     # unique-edge id of each sorted slot = index of its segment head.
     # ONE tuple-carry scan produces the segment head AND the running

@@ -311,6 +311,58 @@ def _qual_kernel(x0, y0, z0, x1, y1, z1, x2, y2, z2, x3, y3, z3,
     out[:] = jnp.where(vol > 0, jnp.minimum(q, 1.0), jnp.minimum(q, 0.0))
 
 
+# ---------------------------------------------------------------------------
+# Inclusive int32 prefix sum: the scan backbone of the incremental
+# topology merge (ops/topo_incr.merge_sorted_band) — survivor ranks and
+# band insertion shifts are both prefix sums over [6*capT]/[4*capT] flag
+# vectors.  Within a block, cumsum along lanes then across sublanes; the
+# running block total is carried across the sequential grid in SMEM.
+# Integer adds are associative, so this is bit-identical to jnp.cumsum.
+# ---------------------------------------------------------------------------
+def _prefix_kernel(x_ref, o_ref, carry):
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _init():
+        carry[0] = 0
+
+    x = x_ref[:]
+    c1 = jnp.cumsum(x, axis=1)                      # within-row inclusive
+    rt = c1[:, _LANE - 1:_LANE]                     # [8,1] row totals
+    roff = jnp.cumsum(rt, axis=0) - rt              # exclusive row offsets
+    o_ref[:] = c1 + roff + carry[0]
+    carry[0] = carry[0] + jnp.sum(x)
+
+
+def _to_blocks_i32(a: jax.Array, rows: int) -> jax.Array:
+    """[n] -> [rows,128] zero-padded int32 view."""
+    n = a.shape[0]
+    flat = jnp.zeros(rows * _LANE, jnp.int32).at[:n].set(
+        a.astype(jnp.int32))
+    return flat.reshape(rows, _LANE)
+
+
+def merge_prefix_pallas(x: jax.Array,
+                        interpret: bool | None = None) -> jax.Array:
+    """Inclusive prefix sum of an int32 vector: [n] -> [n].
+
+    Zero padding at the tail only feeds positions >= n, which are
+    discarded, so the result equals ``jnp.cumsum(x)`` exactly."""
+    n = x.shape[0]
+    rows = _pad_rows(n)
+    spec = pl.BlockSpec((_SUB, _LANE), lambda i: (i, 0))
+    out = pl.pallas_call(
+        _prefix_kernel,
+        out_shape=jax.ShapeDtypeStruct((rows, _LANE), jnp.int32),
+        grid=(rows // _SUB,),
+        in_specs=[spec],
+        out_specs=spec,
+        scratch_shapes=[pltpu.SMEM((1,), jnp.int32)],
+        interpret=_auto_interpret(interpret),
+    )(_to_blocks_i32(x, rows))
+    return out.reshape(-1)[:n]
+
+
 def quality_pallas(p: jax.Array, m6bar: jax.Array | None = None,
                    interpret: bool | None = None) -> jax.Array:
     """Fused tet quality. p: [N,4,3]; m6bar: optional [N,6] mean metric."""

@@ -591,26 +591,29 @@ def run_adapt_cycles(stacked, met_s, steps: DistSteps, cycles,
             stacked, met_s, jnp.asarray(c, jnp.int32), lvl)
         if mask_on:
             lvl = lvl2
-        ca = np.asarray(counts)                  # [nblk, 4]
-        na = np.asarray(nact)                    # [nblk] active groups
+        # ONE host pull per array per block (the blessed .tolist()
+        # idiom): the per-field int() casts each forced their own
+        # device sync
+        ca = counts.tolist()                     # [nblk][4]
+        na = nact.tolist()                       # [nblk] active groups
         n_logical = stacked.tmask.shape[0]
         for i in range(nblk):
             cs = ca[i]
             if stats is not None:        # psum'd global counters
-                stats.nsplit += int(cs[0])
-                stats.ncollapse += int(cs[1])
-                stats.nswap += int(cs[2])
-                stats.nmoved += int(cs[3])
+                stats.nsplit += cs[0]
+                stats.ncollapse += cs[1]
+                stats.nswap += cs[2]
+                stats.nmoved += cs[3]
                 stats.cycles += 1
                 # per-group convergence trajectory (the SPMD mirror of
                 # the grouped path's active_groups_per_block)
                 stats.sched_extra.setdefault(
-                    "active_shards_per_cycle", []).append(int(na[i]))
+                    "active_shards_per_cycle", []).append(na[i])
             otrace.log(3, f"  {label} cycle {c + i}: split {cs[0]} "
                           f"collapse {cs[1]} swap {cs[2]} move {cs[3]} "
-                          f"active {int(na[i])}/{n_logical} grp",
+                          f"active {na[i]}/{n_logical} grp",
                        verbose=verbose)
-        if int(ovf) != 0:
+        if ovf.tolist() != 0:
             if regrow_state[0] >= MAX_SHARD_REGROWS:
                 m_, k_, p_ = merge_shards(stacked, met_s,
                                           return_part=True)
@@ -633,7 +636,7 @@ def run_adapt_cycles(stacked, met_s, steps: DistSteps, cycles,
         # EVERY logical group posted zero topological ops ends the pass
         # (active_groups == 0 is exactly the summed-zero rule, read
         # from the per-group counts instead of the psum'd total)
-        if any((flags[i] or noswap) and int(na[i]) == 0
+        if any((flags[i] or noswap) and na[i] == 0
                for i in range(nblk)):
             break
     return stacked, met_s

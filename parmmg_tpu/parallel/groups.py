@@ -153,7 +153,15 @@ def _group_block(flags: tuple, pres: tuple, nomove: bool,
     (the hotloop_knob_gate contract).  The per-slot idle carry is
     derived on-device from each cycle's counts inside the map body —
     a cycle following a full no-op cycle skips its smoothing wave as a
-    proven identity (ops/adapt.py ``smooth_idle``)."""
+    proven identity (ops/adapt.py ``smooth_idle``).
+
+    ``incr``/``topo`` (PARMMG_INCR_TOPO, ops/topo_incr): per-slot
+    retained-sort + dirty-band state rides the group axis through the
+    SAME compiled program — the knob scalar and the state are ALWAYS
+    traced arguments, so toggling the incremental path mints zero new
+    compile families (the hotloop_knob_gate contract).  Quiet/pad slots
+    pass through the ``active`` lax.cond with their state untouched
+    (an idle slot's retained tables stay valid)."""
     from ..ops.adapt import adapt_cycle_impl
     from ..utils.compilecache import governed
     key = (flags, pres, nomove, noinsert, hausd)
@@ -161,36 +169,37 @@ def _group_block(flags: tuple, pres: tuple, nomove: bool,
         return _GROUP_BLOCK_CACHE[key]
 
     def body(args):
-        m, k, wave, act, cad = args
+        m, k, wave, act, cad, inc, tp = args
         counts_all = []
         sm_idle = jnp.zeros((), bool)
         for cc, dosw in enumerate(flags):
             # named_scope: XLA ops of each unrolled cycle carry the
             # phase name on a profiler's device timeline (obs/trace.py)
             with otrace.scope(f"grp_cycle{cc}"):
-                m, k, counts = adapt_cycle_impl(
+                m, k, counts, tp = adapt_cycle_impl(
                     m, k, wave + cc, do_swap=dosw,
                     do_smooth=not nomove, do_insert=not noinsert,
                     hausd=hausd, final_rebuild=(cc == len(flags) - 1),
                     prescreen=pres[cc], active=act,
-                    smooth_idle=cad & sm_idle)
+                    smooth_idle=cad & sm_idle, topo=tp, incr=inc)
             sm_idle = ((counts[0] + counts[1] + counts[2]) == 0) & \
                 (counts[3] == 0)
             counts_all.append(counts)
-        return m, k, jnp.stack(counts_all)       # [n, 6]
+        return m, k, jnp.stack(counts_all), tp   # counts [n, 9]
 
     # variant budget: the cycle scheduler emits a handful of (flags,
     # pres) combos per session and the chunked dispatch pads every
     # chunk to ONE shape family — growth past this is recompile churn
     @governed("groups.adapt_block", budget=6)
     @jax.jit
-    def run(stacked, met_s, wave, active, cadence):
+    def run(stacked, met_s, wave, active, cadence, incr, topo):
         n_map = stacked.vert.shape[0]            # chunk or g_exec
         waves = jnp.full(n_map, wave, jnp.int32)
         cads = jnp.full(n_map, cadence, bool)
-        m, k, counts = jax.lax.map(body,
-                                   (stacked, met_s, waves, active, cads))
-        return m, k, counts                      # counts [G, n, 6]
+        incs = jnp.full(n_map, incr, bool)
+        m, k, counts, tp = jax.lax.map(
+            body, (stacked, met_s, waves, active, cads, incs, topo))
+        return m, k, counts, tp                  # counts [G, n, 9]
 
     _GROUP_BLOCK_CACHE[key] = run
     return run
@@ -242,12 +251,21 @@ def _pad_groups(tree, g_new: int):
 
 
 def _pipeline_chunks(fn, stacked, met_s, wave, plans, tim, done=None,
-                     extra=()):
+                     extra=(), topo=None):
     """Double-buffered chunked dispatch over gathered group-index slices.
 
     ``extra``: additional positional device scalars appended to each
     ``fn`` dispatch after the active mask (the adapt block's traced
-    cadence enable; empty for the polish block).
+    cadence enable + incremental-topology knob; empty for the polish
+    block).
+
+    ``topo``: optional host-numpy TopoState [g_exec, ...]
+    (ops/topo_incr.topo_init_np) — the per-slot retained-table state of
+    the incremental topology engine.  Its rows ride the same gather /
+    dispatch / writeback path as the mesh state, and like it they only
+    mutate when a drain COMMITS, so the band state is covered by the
+    idempotent-writeback contract: a faulted dispatch's retry replays
+    from the retained table bit-for-bit.
 
     ``plans``: [(idx_exec [chunk], nreal)] from the quiet-group
     scheduler (parallel/sched.py); the SAME compiled [chunk, ...]
@@ -316,22 +334,30 @@ def _pipeline_chunks(fn, stacked, met_s, wave, plans, tim, done=None,
             # nothing (lax.cond identity) — their results were always
             # discarded at writeback (sched.pad_mask)
             act = jnp.asarray(pad_mask(len(idx), nreal))
+            tl = None if topo is None else \
+                jax.tree.map(lambda a: jnp.asarray(a[idx]), topo)
         faultpoint("dispatch.chunk", key=str(pi))
         with otrace.annotate(f"grp_dispatch_chunk{pi}"):
-            m, k, cnt = fn(sl, kl, wave, act, *extra)
-        return (pi, idx, nreal, m, k, cnt)
+            if topo is None:
+                m, k, cnt = fn(sl, kl, wave, act, *extra)
+                tp = None
+            else:
+                m, k, cnt, tp = fn(sl, kl, wave, act, *extra, tl)
+        return (pi, idx, nreal, m, k, cnt, tp)
 
     # lint: ok(R2) — the pipeline's ONE designed sync point: chunked
     # mode keeps the pass state host-resident, so the drain downloads
-    # O(chunk) tables + [chunk,nblk,8] counters while chunk k+1 is
+    # O(chunk) tables + [chunk,nblk,9] counters while chunk k+1 is
     # already dispatched (PR-5 double buffering; segments timed)
     def drain(p):
-        pi, idx, nreal, m, k, cnt = p
+        pi, idx, nreal, m, k, cnt, tp = p
         with tim("compute"):
             jax.block_until_ready(cnt)
         with tim("download"):
             mh = jax.tree.map(lambda s: np.asarray(s), m)
             kh = np.asarray(k)
+            th = None if tp is None else \
+                jax.tree.map(lambda s: np.asarray(s), tp)
             out[pi] = np.asarray(cnt)[:nreal]
         with tim("writeback"):
             rows = idx[:nreal]
@@ -341,6 +367,8 @@ def _pipeline_chunks(fn, stacked, met_s, wave, plans, tim, done=None,
                 return d
             jax.tree.map(w, stacked, mh)
             met_s[rows] = kh[:nreal]
+            if th is not None:
+                jax.tree.map(w, topo, th)
         if done is not None:
             done[pi] = out[pi]
 
@@ -483,6 +511,16 @@ def grouped_adapt_pass(mesh: Mesh, met, ngroups: int, cycles: int = 12,
     # PARMMG_SMOOTH_CADENCE mints zero new compile families
     from .sched import cadence_enabled
     cad = jnp.asarray(cadence_enabled())
+    # incremental topology engine (ops/topo_incr, PARMMG_INCR_TOPO):
+    # per-slot retained-table + dirty-band state rides the group axis —
+    # host-resident in chunk mode (rows committed by drain writebacks,
+    # same idempotent contract as the mesh state), device-resident
+    # otherwise.  The knob is a traced scalar like the cadence.
+    from ..ops.topo_incr import incr_topo_enabled, topo_init, topo_init_np
+    inc = jnp.asarray(incr_topo_enabled())
+    capT_s = stacked.tet.shape[1]
+    topo_s = topo_init_np(g_exec, capT_s) if chunk else \
+        topo_init(capT_s, stack=g_exec)
     # pipeline segment timers on a LOCAL registry: folded into
     # stats.sched_extra and (prefixed) into the caller's Timers at the
     # end, so the driver report shows the transfer/compute split
@@ -490,6 +528,7 @@ def grouped_adapt_pass(mesh: Mesh, met, ngroups: int, cycles: int = 12,
     block = default_cycle_block(stacked.vert)
     c = 0
     regrows = 0
+    dirty_traj: list[int] = []
     while c < cycles:
         nblk = min(block, cycles - c)
         flags, pres = block_schedule(c, nblk, cycles, noswap)
@@ -501,10 +540,11 @@ def grouped_adapt_pass(mesh: Mesh, met, ngroups: int, cycles: int = 12,
         with otrace.context(block=c, chunk=chunk or 0):
             if chunk:
                 parts = _pipeline_chunks(step, stacked, met_s, wave,
-                                         plans, ltim, extra=(cad,))
+                                         plans, ltim, extra=(cad, inc),
+                                         topo=topo_s)
                 sched.note_plan_pads(plans)
                 counts_act = np.concatenate(parts) if parts else \
-                    np.zeros((0, nblk, 8), np.int32)
+                    np.zeros((0, nblk, 9), np.int32)
                 if sched.enabled:
                     otrace.log(
                         2, f"  grp block {c}..{c + nblk - 1}: active "
@@ -515,10 +555,11 @@ def grouped_adapt_pass(mesh: Mesh, met, ngroups: int, cycles: int = 12,
                 # shape — the device-resident quiet mask is what skips
                 # converged groups here (lax.cond identity rows,
                 # sched.block_mask; bit-for-bit by the fixed point)
-                stacked, met_s, counts = step(
+                stacked, met_s, counts, topo_s = step(
                     stacked, met_s, wave,
-                    jnp.asarray(sched.block_mask(pres_all_on)), cad)
-                counts_act = np.asarray(counts)  # [g_exec, nblk, 8]
+                    jnp.asarray(sched.block_mask(pres_all_on)), cad,
+                    inc, topo_s)
+                counts_act = np.asarray(counts)  # [g_exec, nblk, 9]
         sched.record_block(act, counts_act, swap_inc, pres_all_on)
         # quiet groups contribute exact zeros (that is what marked them)
         cs = counts_act.sum(axis=0, dtype=np.int64)     # [nblk, 8]
@@ -528,6 +569,9 @@ def grouped_adapt_pass(mesh: Mesh, met, ngroups: int, cycles: int = 12,
         cs_l = cs.tolist()                              # python ints
         for i in range(nblk):
             tot = cs_l[i]
+            # counts[8]: dirty tets pending at each cycle start, summed
+            # over groups — the band-occupancy trajectory (bench extras)
+            dirty_traj.append(tot[8])
             if stats is not None:
                 stats.nsplit += tot[0]
                 stats.ncollapse += tot[1]
@@ -572,6 +616,12 @@ def grouped_adapt_pass(mesh: Mesh, met, ngroups: int, cycles: int = 12,
             else:
                 stacked, met_s = grow_shards(stacked, met_s, 2 * capP,
                                              2 * capT)
+            # regrow permutes tet slots (compact) and changes capT: the
+            # retained sorts are stale at the new capacity — re-init
+            # (ok=False => next derivation is a full rebuild, exact)
+            capT_s = stacked.tet.shape[1]
+            topo_s = topo_init_np(g_exec, capT_s) if chunk else \
+                topo_init(capT_s, stack=g_exec)
             regrows += 1
             # the wave top-K budgets scale with capT: every quiet proof
             # is stale at the new capacity — reactivate the full set
@@ -834,6 +884,11 @@ def grouped_adapt_pass(mesh: Mesh, met, ngroups: int, cycles: int = 12,
                 round(overhead, 4))
         se.setdefault("active_groups_per_block", []).extend(
             sched.active_per_block)
+        if dirty_traj:
+            # per-cycle dirty-band occupancy (counts[8] summed over
+            # groups): shows when the incremental path engages and how
+            # small the decay-regime bands get (bench extra.incr_topo)
+            se.setdefault("incr_dirty_per_cycle", []).extend(dirty_traj)
         if pol_traj:
             se.setdefault("polish_active_per_wave", []).extend(pol_traj)
         for k, v in ltim.acc.items():

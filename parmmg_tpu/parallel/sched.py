@@ -134,9 +134,12 @@ def pad_mask(chunk: int, nreal: int) -> np.ndarray:
 def quiet_rows(counts: np.ndarray) -> np.ndarray:
     """Per-row fixed-point witness from a dispatched block's counts.
 
-    ``counts``: [n, nblk, >=5].  Row ``i`` is quiet when the WHOLE
-    block was a no-op for it — zero split+collapse+swap+move AND zero
-    overflow (a truncated winner set witnesses nothing).  Shared by
+    ``counts``: [n, nblk, >=5] — reads ONLY columns 0..4, so the
+    9-wide rows of the topo-threaded block (col 8 = dirty-tet count,
+    ops/topo_incr) satisfy the contract unchanged.  Row ``i`` is quiet
+    when the WHOLE block was a no-op for it — zero
+    split+collapse+swap+move AND zero overflow (a truncated winner set
+    witnesses nothing).  Shared by
     :meth:`QuietGroupScheduler.record_block` (group granularity) and
     the serving pool (serve/pool.py, tenant granularity): one rule, one
     exactness argument (module docstring)."""

@@ -166,9 +166,12 @@ def hotloop_knob_gate() -> int:
     cadence, the facesort swap pairing, the donor-band collapse apply
     or the Pallas scoring prep may not mint a single new ``groups.*``
     compile family in a warm process.  Two distinct mechanisms back
-    this: PARMMG_SMOOTH_CADENCE is a TRACED device scalar of the
-    compiled block (like the quiet mask — toggling changes an input
-    value, never the program), while the facesort / band / score knobs
+    this: PARMMG_SMOOTH_CADENCE and PARMMG_INCR_TOPO are TRACED device
+    scalars of the compiled block (like the quiet mask — toggling
+    changes an input value, never the program; the incremental path's
+    band/table shapes are capT-static ladder rungs, so the knob-on arm
+    adds no shape families either), while the facesort / band / score
+    knobs
     are trace-time reads whose both settings produce bit-identical
     results, so the warm ``_GROUP_BLOCK_CACHE`` program from the first
     run legitimately serves the flipped runs (a stale entry is only a
@@ -183,7 +186,8 @@ def hotloop_knob_gate() -> int:
     from parmmg_tpu.utils.fixtures import cube_mesh
 
     KNOBS = ("PARMMG_SMOOTH_CADENCE", "PARMMG_SWAP_FACESORT",
-             "PARMMG_COLLAPSE_BAND", "PARMMG_PALLAS_SCORE")
+             "PARMMG_COLLAPSE_BAND", "PARMMG_PALLAS_SCORE",
+             "PARMMG_INCR_TOPO")
 
     def run(setting: str):
         for k in KNOBS:
@@ -230,7 +234,7 @@ def hotloop_knob_gate() -> int:
             print(f"  {v}", file=sys.stderr)
         return 1
     print(f"hot-loop knobs OK: zero new compile families ({v1}; "
-          "cadence, facesort, collapse band, pallas score)")
+          "cadence, facesort, collapse band, pallas score, incr topo)")
     return 0
 
 

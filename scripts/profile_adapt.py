@@ -56,6 +56,7 @@ from parmmg_tpu.utils.fixtures import cube_mesh, analytic_iso_metric
 
 
 PHASES_MS: dict[str, float] = {}    # label -> min ms (the --json payload)
+INCR: dict = {}                     # incremental-topology occupancy facts
 
 
 def timeit(label, fn, *args, reps=3, **kw):
@@ -129,6 +130,49 @@ def main():
         del os.environ["PARMMG_COLLAPSE_BAND"]
     timeit("smooth_wave", lambda m, k: smooth_wave(m, k), mesh, met)
 
+    # incremental-topology segments (PARMMG_INCR_TOPO, ops/topo_incr):
+    # STABLE phase names — band_extract / band_merge / band_adjacency
+    # vs the full-rebuild names above (unique_edges, build_adjacency,
+    # boundary_edge_tags).  Timed at a half-full band, the decay-regime
+    # shape the knob targets.
+    from parmmg_tpu.ops.adapt import adapt_cycle_impl
+    from parmmg_tpu.ops.topo_incr import (
+        edge_band_records, incr_band_width, incr_build_adjacency,
+        incr_unique_edges, topo_init)
+    bw = incr_band_width(mesh.capT)
+    on = jnp.ones((), bool)
+
+    def _seed(m, t):
+        _, t = incr_unique_edges(m, t, on)
+        _, t = incr_build_adjacency(m, t, on)
+        return t
+    topo1 = jax.jit(_seed)(mesh, topo_init(mesh.capT))
+    live = np.flatnonzero(np.asarray(mesh.tmask))[:max(1, bw // 2)]
+    dirty = np.zeros(mesh.capT, bool)
+    dirty[live] = True
+    topo_d = topo1._replace(edirty=jnp.asarray(dirty),
+                            fdirty=jnp.asarray(dirty))
+    dt = jnp.asarray(np.concatenate(
+        [live, np.full(bw - len(live), mesh.capT)]).astype(np.int32))
+    timeit("band_extract", edge_band_records, mesh, dt)
+    timeit("band_merge",
+           lambda m, t: incr_unique_edges(m, t, on), mesh, topo_d)
+    timeit("band_adjacency",
+           lambda m, t: incr_build_adjacency(m, t, on), mesh, topo_d)
+    # per-cycle dirty-band occupancy: thread TopoState through real
+    # cycles and read counts[8] (dirty tets at cycle start) — the
+    # occupancy the band (width bw) must absorb to stay incremental
+    step = jax.jit(lambda m, k, w, t: adapt_cycle_impl(
+        m, k, w, topo=t, incr=on))
+    mi, ki, ti = mesh, met, topo1
+    occ = []
+    for cyc in range(4):
+        mi, ki, cnt, ti = step(mi, ki, jnp.asarray(cyc, jnp.int32), ti)
+        occ.append(int(np.asarray(cnt)[8]))
+    INCR.update(band_width=bw, band_dirty=int(dirty.sum()),
+                dirty_per_cycle=occ)
+    print(f"  {'dirty band':28s} width {bw}, per-cycle occupancy {occ}")
+
     # full cycles, as bench runs them.  adapt_cycle DONATES its inputs, so
     # deep-copy the state before each flavor (and time the second call —
     # the first may absorb a compile or a transport stall)
@@ -155,7 +199,8 @@ def main():
         with open(json_out, "w") as f:
             json.dump({"n": n, "ntets": len(tet),
                        "device": jax.devices()[0].platform,
-                       "phases_ms": PHASES_MS}, f, indent=1)
+                       "phases_ms": PHASES_MS, "incr": INCR}, f,
+                      indent=1)
         print(f"profile: phase timings written to {json_out}",
               file=sys.stderr)
 
