@@ -301,6 +301,7 @@ def main() -> None:
     group_sched = None
     parity_ok = None
     incr_topo = None
+    pallas_sort = None
     if os.environ.get("BENCH_GROUPED", "0") == "1":
         from parmmg_tpu.core.mesh import MESH_FIELDS
         from parmmg_tpu.ops.adapt import AdaptStats
@@ -310,7 +311,7 @@ def main() -> None:
         cycles_g = int(os.environ.get("BENCH_GROUPED_CYCLES", "12"))
         prev_env = {k: os.environ.get(k)
                     for k in ("PARMMG_GROUP_CHUNK", "PARMMG_DEVICE_MASK",
-                              "PARMMG_INCR_TOPO")}
+                              "PARMMG_INCR_TOPO", "PARMMG_PALLAS_SORT")}
         os.environ["PARMMG_GROUP_CHUNK"] = "0"
         # x-slab groups on the shock metric, with the far field CLAMPED
         # into the metric dead band (h <= 1.3/n: edges stay inside
@@ -400,6 +401,36 @@ def main() -> None:
                 "dirty_per_cycle":
                     st3.sched_extra.get("incr_dirty_per_cycle", []),
             }
+            # Pallas sort-engine A/B (PARMMG_PALLAS_SORT, ISSUE 20): the
+            # SAME mask-on pass re-runs with the knob forced on.  On a
+            # CPU backend the dispatcher still lowers only the jnp
+            # reference (platform_dependent picks at trace time), so the
+            # numbers document the reference path honestly and
+            # sites_pallas says which sites WOULD dispatch the kernels;
+            # the TPU claim rides the next chip session.  Outputs and op
+            # counters must stay bit-identical either way.
+            from parmmg_tpu.ops.pallas_kernels import pallas_sort_sites
+            os.environ["PARMMG_PALLAS_SORT"] = "1"
+            srt_g, ksrt_g, st4, t_srt = run_grouped("1", reps=3)
+            sort_sites = pallas_sort_sites()
+            os.environ.pop("PARMMG_PALLAS_SORT", None)
+            sort_parity = bool(
+                all((np.asarray(getattr(chk_g, f))
+                     == np.asarray(getattr(srt_g, f))).all()
+                    for f in MESH_FIELDS)
+                and (np.asarray(kchk_g) == np.asarray(ksrt_g)).all()
+                and (st4.nsplit, st4.ncollapse, st4.nswap, st4.nmoved)
+                == (st1.nsplit, st1.ncollapse, st1.nswap, st1.nmoved))
+            pallas_sort = {
+                "off_s_per_cycle": round(t_on / max(st1.cycles, 1), 4),
+                "on_s_per_cycle": round(t_srt / max(st4.cycles, 1), 4),
+                "speedup": round(t_on / t_srt, 3),
+                "parity_ok": sort_parity,
+                # sort sites that dispatched the Pallas kernels on THIS
+                # backend (empty off-TPU: the knob-on arm lowered the
+                # bit-identical jnp reference)
+                "sites_pallas": sort_sites,
+            }
             group_sched = {
                 "ngroups": ngr,
                 "cycles": st1.cycles,
@@ -477,6 +508,11 @@ def main() -> None:
                # s/cycle with PARMMG_INCR_TOPO off vs on + dirty-band
                # trajectory; outputs bit-identical (parity_ok)
                "incr_topo": incr_topo,
+               # Pallas radix-sort engine A/B (BENCH_GROUPED=1):
+               # same-machine s/cycle with PARMMG_PALLAS_SORT off vs on;
+               # off-TPU both arms lower the same jnp reference program
+               # (sites_pallas records where the kernels would land)
+               "pallas_sort": pallas_sort,
                "profile_phases": profile_phases,
                "device": str(jax.devices()[0].platform),
                "fallback": os.environ.get(

@@ -182,6 +182,14 @@ KNOBS: dict[str, Knob] = {
         "top-k budget prep (ops/pallas_kernels.py; dispatched on TPU "
         "only — CPU always uses the bit-identical jnp reference); "
         "0 = jnp reference everywhere"),
+    "PARMMG_PALLAS_SORT": Knob(
+        "flag", "",
+        "Pallas radix-sort/segment engine for the edge/face/band sort "
+        "sites (ops/pallas_kernels.py sort_perm/segment_first; stable "
+        "LSD radix = bit-identical to the jnp argsort/lexsort "
+        "reference); empty = platform-aware default like "
+        "PARMMG_SWAP_FACESORT (on iff the backend is a TPU), 1/0 "
+        "force"),
     "PARMMG_POLISH_SUBPROC": Knob(
         "flag", "",
         "grouped polish phase in a subprocess worker (the TPU-tunnel "

@@ -16,6 +16,7 @@ import jax.numpy as jnp
 
 from ..core.mesh import Mesh, tet_edge_vertices
 from ..core.constants import IARE
+from . import pallas_kernels as pk
 
 _INT32_MAX = 2147483647
 
@@ -66,19 +67,18 @@ def sort_pairs(a: jax.Array, b: jax.Array, valid: jax.Array, capP: int):
     """
     if capP <= PACK_LIMIT:
         key = jnp.where(valid, a * capP + b, _INT32_MAX)
-        order = jnp.argsort(key)
+        order = pk.sort_perm((key,), ref=lambda ws: jnp.argsort(ws[0]))
         ks = key[order]
-        first = jnp.concatenate([jnp.array([True]), ks[1:] != ks[:-1]])
+        first = pk.segment_first((ks,))
         inv = ks == _INT32_MAX
         ka = jnp.where(inv, _INT32_MAX, ks // capP)
         kb = jnp.where(inv, _INT32_MAX, ks % capP)
         return order, ka, kb, first
     aa = jnp.where(valid, a, _INT32_MAX)
     bb = jnp.where(valid, b, _INT32_MAX)
-    order = jnp.lexsort((bb, aa))
+    order = pk.sort_perm((aa, bb), ref=lambda ws: jnp.lexsort((ws[1], ws[0])))
     ka, kb = aa[order], bb[order]
-    first = jnp.concatenate([jnp.array([True]),
-                             (ka[1:] != ka[:-1]) | (kb[1:] != kb[:-1])])
+    first = pk.segment_first((ka, kb))
     return order, ka, kb, first
 
 
@@ -162,7 +162,7 @@ def unique_edges_from_sorted(mesh: Mesh, order: jax.Array, ks: jax.Array,
     code: tag payloads are re-gathered from the CURRENT mesh here, so
     the retained state never carries tags.  Requires
     ``capP <= PACK_LIMIT``."""
-    first = jnp.concatenate([jnp.array([True]), ks[1:] != ks[:-1]])
+    first = pk.segment_first((ks,))
     inv = ks == _INT32_MAX
     ka = jnp.where(inv, _INT32_MAX, ks // mesh.capP)
     kb = jnp.where(inv, _INT32_MAX, ks % mesh.capP)
@@ -372,11 +372,21 @@ def unique_priority(score: jax.Array, mask: jax.Array) -> jax.Array:
     """
     n = score.shape[0]
     neg = jnp.where(mask, -score, jnp.inf)
-    order = jnp.argsort(neg)          # best (highest score) first
+    order = priority_order(neg)       # best (highest score) first
     rank = jnp.zeros(n, jnp.int32).at[order].set(
         jnp.arange(n, dtype=jnp.int32))
     pri = n - rank                    # in [1, n], unique
     return jnp.where(mask, pri, 0).astype(jnp.int32)
+
+
+def priority_order(neg: jax.Array) -> jax.Array:
+    """Stable ascending argsort of the negated-score vector — the
+    priority rank's sort leg, dispatched to the Pallas radix engine on
+    TPU (PARMMG_PALLAS_SORT).  The radix image of f32 preserves jax's
+    stable comparator order exactly (pallas_kernels.f32_sort_u32), and
+    LSD stability reproduces the documented argsort-rank tie-break (the
+    lane index is the implicit minor word)."""
+    return pk.sort_perm_f32(neg, ref=jnp.argsort)
 
 
 # ---------------------------------------------------------------------------

@@ -72,6 +72,19 @@ def pallas_score_enabled() -> bool:
     return os.environ.get("PARMMG_PALLAS_SCORE", "") != "0"
 
 
+def pallas_sort_enabled() -> bool:
+    """PARMMG_PALLAS_SORT gate for the radix-sort/segment engine
+    (radix_sort_pallas / segment_flags_pallas, dispatched through
+    sort_perm / sort_perm_f32 / segment_first below).  Platform-aware
+    default like PARMMG_SWAP_FACESORT: unset = on iff the process
+    default backend is a TPU (off-TPU the stable jnp argsort/lexsort
+    reference is the right program); 1/0 force either way."""
+    v = os.environ.get("PARMMG_PALLAS_SORT", "")
+    if v == "":
+        return jax.default_backend() == "tpu"
+    return v != "0"
+
+
 def _pad_rows(n: int) -> int:
     """Rows of a [R,128] view holding n elements, R a multiple of 8."""
     r = -(-n // _LANE)
@@ -389,3 +402,215 @@ def quality_pallas(p: jax.Array, m6bar: jax.Array | None = None,
         interpret=_auto_interpret(interpret),
     )(*args)
     return _from_blocks(out, n, p.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Radix sort / segment engine (ISSUE 20).  A stable tiled LSD radix sort
+# over logical multi-word keys: each word is sorted least-significant
+# first in 8-bit digit passes.  One Pallas kernel per pass computes, over
+# a sequential grid of (8,128) blocks, the stable within-digit rank of
+# every element plus the per-block digit histogram; the scatter offsets
+# come from merge_prefix_pallas over the digit-major/block-minor
+# flattened histogram (the PR 18 prefix leg, reused).  Stability makes
+# the permutation bit-identical to jnp.argsort / jnp.lexsort: LSD radix
+# ties resolve by position, exactly like jax's stable comparator sort.
+# Gathers/scatters between passes stay in XLA.
+# ---------------------------------------------------------------------------
+_RADIX = 256
+_I32_MAX = 2147483647
+
+
+def _radix_pass_kernel(d_ref, rank_ref, hist_ref):
+    d = d_ref[:]
+    oh = (d[:, :, None] == jax.lax.broadcasted_iota(
+        jnp.int32, (_SUB, _LANE, _RADIX), 2)).astype(jnp.int32)
+    c1 = jnp.cumsum(oh, axis=1)                     # within-row, per digit
+    rt = c1[:, _LANE - 1:_LANE, :]                  # [8,1,256] row totals
+    roff = jnp.cumsum(rt, axis=0) - rt              # exclusive row offsets
+    rank_ref[:] = jnp.sum((c1 + roff) * oh, axis=2) - 1
+    hist_ref[:] = jnp.sum(oh, axis=(0, 1))[None, :]
+
+
+def radix_sort_pallas(words, nbits=None, interpret=None):
+    """Stable multi-word sort permutation: argsort of the logical key
+    whose major word is words[0].  Each word holds non-negative int32
+    values (uint32 digit order == int32 order for those).  ``nbits[j]``
+    bounds word j's valid values below 2**nbits[j]; words with
+    nbits < 31 get their INT32_MAX tombstones remapped to the in-range
+    maximum (order-preserving: every valid value is strictly smaller),
+    cutting digit passes.  Tail padding uses 0xFFFFFFFF, which sorts
+    after every key; ties against real 0xFFFFFFFF keys keep real rows
+    first by stability, so the returned ``order[:n]`` is exact."""
+    n = words[0].shape[0]
+    rows = _pad_rows(n)
+    npad = rows * _LANE
+    nblocks = rows // _SUB
+    interp = _auto_interpret(interpret)
+    if nbits is None:
+        nbits = (32,) * len(words)
+    order = jnp.arange(npad, dtype=jnp.int32)
+    pos_blk = jnp.arange(npad, dtype=jnp.int32) // _BLOCK
+    spec = pl.BlockSpec((_SUB, _LANE), lambda i: (i, 0))
+    hspec = pl.BlockSpec((1, _RADIX), lambda i: (i, 0))
+    for w, bits in list(zip(words, nbits))[::-1]:   # LSD: minor word first
+        wu = w.astype(jnp.uint32)
+        if bits < 31:
+            wu = jnp.where(wu == jnp.uint32(_I32_MAX),
+                           jnp.uint32((1 << bits) - 1), wu)
+        wp = jnp.full(npad, jnp.uint32(0xFFFFFFFF)).at[:n].set(wu)
+        for shift in range(0, bits, 8):
+            g = wp[order]
+            d = ((g >> jnp.uint32(shift)) & jnp.uint32(0xFF)).astype(jnp.int32)
+            rank, hist = pl.pallas_call(
+                _radix_pass_kernel,
+                out_shape=(jax.ShapeDtypeStruct((rows, _LANE), jnp.int32),
+                           jax.ShapeDtypeStruct((nblocks, _RADIX), jnp.int32)),
+                grid=(nblocks,),
+                in_specs=[spec],
+                out_specs=(spec, hspec),
+                interpret=interp,
+            )(d.reshape(rows, _LANE))
+            flat = hist.T.reshape(-1)               # digit-major, block-minor
+            excl = merge_prefix_pallas(flat, interpret=interpret) - flat
+            dest = excl[d * nblocks + pos_blk] + rank.reshape(-1)
+            order = jnp.zeros(npad, jnp.int32).at[dest].set(
+                order, unique_indices=True)
+    return order[:n]
+
+
+def f32_sort_u32(x: jax.Array) -> jax.Array:
+    """Map float32 to uint32 so radix digit order mirrors jax's stable
+    sort comparator exactly: -0.0 == +0.0 (ties by position), all NaNs
+    equal and after +inf.  NaN maps to 0xFFFFFFFF, colliding with tail
+    padding — stability keeps real rows ahead of pads, so order[:n] is
+    still exact."""
+    x = jnp.where(x == 0.0, 0.0, x)
+    b = jax.lax.bitcast_convert_type(x.astype(jnp.float32), jnp.uint32)
+    u = jnp.where(b >> 31 != 0, ~b, b | jnp.uint32(0x80000000))
+    return jnp.where(jnp.isnan(x), jnp.uint32(0xFFFFFFFF), u)
+
+
+def _seg_kernel(*refs, nw):
+    word_refs = refs[:nw]
+    o_ref = refs[nw]
+    carry = refs[nw + 1]
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _init():
+        for j in range(nw):
+            carry[j] = 0
+
+    r_io = jax.lax.broadcasted_iota(jnp.int32, (_SUB, _LANE), 0)
+    l_io = jax.lax.broadcasted_iota(jnp.int32, (_SUB, _LANE), 1)
+    neq = jnp.zeros((_SUB, _LANE), jnp.int32)
+    for j in range(nw):
+        x = word_refs[j][:]
+        rowlast = x[:, _LANE - 1:_LANE]
+        shifted = jnp.concatenate(
+            [jnp.full((1, 1), carry[j], jnp.int32), rowlast[:-1]], axis=0)
+        prev = jnp.concatenate([shifted, x[:, :-1]], axis=1)
+        neq = neq | (x != prev).astype(jnp.int32)
+        carry[j] = jnp.sum(
+            jnp.where((r_io == _SUB - 1) & (l_io == _LANE - 1), x, 0))
+    first0 = ((i == 0) & (r_io == 0) & (l_io == 0)).astype(jnp.int32)
+    o_ref[:] = neq | first0
+
+
+def segment_flags_pallas(words, interpret=None):
+    """Boolean segment-start flags over sorted columns: first[i] is True
+    iff i == 0 or any words[j][i] != words[j][i-1].  Cross-block
+    previous elements ride an SMEM carry.  Zero tail padding only feeds
+    positions >= n, which are discarded."""
+    n = words[0].shape[0]
+    rows = _pad_rows(n)
+    nw = len(words)
+    spec = pl.BlockSpec((_SUB, _LANE), lambda i: (i, 0))
+    out = pl.pallas_call(
+        functools.partial(_seg_kernel, nw=nw),
+        out_shape=jax.ShapeDtypeStruct((rows, _LANE), jnp.int32),
+        grid=(rows // _SUB,),
+        in_specs=[spec] * nw,
+        out_specs=spec,
+        scratch_shapes=[pltpu.SMEM((nw,), jnp.int32)],
+        interpret=_auto_interpret(interpret),
+    )(*[_to_blocks_i32(w, rows) for w in words])
+    return out.reshape(-1)[:n].astype(bool)
+
+
+# -- dispatch helpers --------------------------------------------------------
+def _sort_dispatch_on() -> bool:
+    return HAVE_PALLAS and use_pallas() and pallas_sort_enabled()
+
+
+def sort_perm(words, ref, nbits=None):
+    """Sort-permutation dispatcher.  ``words`` is a tuple of int32
+    columns, major first; ``ref`` is the stable jnp reference taking the
+    same tuple.  TPU gets the radix engine; everywhere else lowers only
+    the reference (identical HLO knob-on/off), except under forced
+    Pallas where the interpreter runs for parity tests."""
+    from ..utils.jaxcompat import platform_dependent
+    words = tuple(words)
+    if not _sort_dispatch_on():
+        return ref(words)
+    krn = functools.partial(radix_sort_pallas, nbits=nbits, interpret=False)
+    if pallas_forced():
+        default = functools.partial(radix_sort_pallas, nbits=nbits,
+                                    interpret=True)
+    else:
+        default = ref
+    return platform_dependent(words, tpu=krn, default=default)
+
+
+def sort_perm_f32(x, ref):
+    """Float argsort dispatcher: the Pallas branch radix-sorts the
+    order-preserving uint32 image of x (f32_sort_u32); the reference
+    branch runs the stable jnp argsort on x itself."""
+    from ..utils.jaxcompat import platform_dependent
+    if not _sort_dispatch_on():
+        return ref(x)
+
+    def krn(v, interpret):
+        u = f32_sort_u32(v).astype(jnp.int32)
+        return radix_sort_pallas((u,), interpret=interpret)
+
+    if pallas_forced():
+        default = functools.partial(krn, interpret=True)
+    else:
+        default = ref
+    return platform_dependent(x, tpu=functools.partial(krn, interpret=False),
+                              default=default)
+
+
+def segment_first(words):
+    """Segment-start dispatcher over sorted columns; the reference is the
+    canonical concat-of-neighbour-compares the call sites used inline."""
+    from ..utils.jaxcompat import platform_dependent
+    words = tuple(words)
+
+    def ref(ws):
+        neq = ws[0][1:] != ws[0][:-1]
+        for w in ws[1:]:
+            neq = neq | (w[1:] != w[:-1])
+        return jnp.concatenate([jnp.array([True]), neq])
+
+    if not _sort_dispatch_on():
+        return ref(words)
+    krn = functools.partial(segment_flags_pallas, interpret=False)
+    if pallas_forced():
+        default = functools.partial(segment_flags_pallas, interpret=True)
+    else:
+        default = ref
+    return platform_dependent(words, tpu=krn, default=default)
+
+
+def pallas_sort_sites():
+    """Static site list the sort engine would dispatch on this backend —
+    empty unless the knob is on and the backend is TPU (or Pallas is
+    forced into the interpreter).  Feeds the bench artifact."""
+    if not _sort_dispatch_on():
+        return []
+    if jax.default_backend() != "tpu" and not pallas_forced():
+        return []
+    return ["unique_edges_sort", "unique_edges_segment", "priority_sort",
+            "face_sort", "band_sort"]

@@ -441,11 +441,14 @@ def packed_halo_rows(nbr: np.ndarray, G: int,
         occupancy = float(os.environ.get("PARMMG_HALO_PACK_OCC", "0.75"))
     S_l, K = nbr.shape
     S = S_l // G
-    counts = np.zeros((S, max(S, 1)), np.int64)
-    for l in range(S_l):
-        for b in nbr[l][nbr[l] >= 0]:
-            counts[l // G, int(b) // G] += 1
-    mx = int(counts.max()) if counts.size else 0
+    # one-pull .tolist() then pure-Python counting: no per-entry
+    # device-array int() coercions inside the loop (lint R2)
+    counts = [[0] * max(S, 1) for _ in range(S)]
+    for l, row in enumerate(nbr.tolist()):
+        for b in row:
+            if b >= 0:
+                counts[l // G][b // G] += 1
+    mx = max((c for row in counts for c in row), default=0)
     if mx == 0:
         return None           # no traffic: no evidence, state untouched
     r = mx / float(G * G)

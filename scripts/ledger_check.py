@@ -163,16 +163,17 @@ def grouped_sched_gate() -> int:
 def hotloop_knob_gate() -> int:
     """Hot-loop knob compile-family gate (the cycle-cost demolition
     attacks, README "Hot-loop cycle costs"): flipping the smoothing
-    cadence, the facesort swap pairing, the donor-band collapse apply
-    or the Pallas scoring prep may not mint a single new ``groups.*``
+    cadence, the facesort swap pairing, the donor-band collapse apply,
+    the Pallas scoring prep or the Pallas sort engine may not mint a
+    single new ``groups.*``
     compile family in a warm process.  Two distinct mechanisms back
     this: PARMMG_SMOOTH_CADENCE and PARMMG_INCR_TOPO are TRACED device
     scalars of the compiled block (like the quiet mask — toggling
     changes an input value, never the program; the incremental path's
     band/table shapes are capT-static ladder rungs, so the knob-on arm
-    adds no shape families either), while the facesort / band / score
-    knobs
-    are trace-time reads whose both settings produce bit-identical
+    adds no shape families either), while the facesort / band / score /
+    sort knobs are trace-time reads whose both settings produce
+    bit-identical
     results, so the warm ``_GROUP_BLOCK_CACHE`` program from the first
     run legitimately serves the flipped runs (a stale entry is only a
     perf choice, never a correctness one)."""
@@ -187,7 +188,7 @@ def hotloop_knob_gate() -> int:
 
     KNOBS = ("PARMMG_SMOOTH_CADENCE", "PARMMG_SWAP_FACESORT",
              "PARMMG_COLLAPSE_BAND", "PARMMG_PALLAS_SCORE",
-             "PARMMG_INCR_TOPO")
+             "PARMMG_INCR_TOPO", "PARMMG_PALLAS_SORT")
 
     def run(setting: str):
         for k in KNOBS:
@@ -220,7 +221,8 @@ def hotloop_knob_gate() -> int:
                 os.environ[k] = v
     assert v0.get("groups.adapt_block", 0) >= 1, \
         "hot-loop knob scenario no longer exercises groups.adapt_block"
-    print("--- hot-loop knob scenario (cadence/facesort/band/score)")
+    print("--- hot-loop knob scenario "
+          "(cadence/facesort/band/score/sort)")
     if v1 != v0:
         print("HOT-LOOP KNOB COMPILE-FAMILY REGRESSIONS (knobs-on run "
               f"added variants vs knobs-off): {v0} -> {v1}",
@@ -234,7 +236,8 @@ def hotloop_knob_gate() -> int:
             print(f"  {v}", file=sys.stderr)
         return 1
     print(f"hot-loop knobs OK: zero new compile families ({v1}; "
-          "cadence, facesort, collapse band, pallas score, incr topo)")
+          "cadence, facesort, collapse band, pallas score, incr topo, "
+          "pallas sort)")
     return 0
 
 

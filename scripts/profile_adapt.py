@@ -107,6 +107,34 @@ def main():
     et = timeit("unique_edges", unique_edges, mesh)
     lens = timeit("edge_lengths", edge_lengths, mesh, et, met)
     timeit("unique_priority", unique_priority, lens, et.emask)
+    # Pallas sort-engine sub-phases (PARMMG_PALLAS_SORT): STABLE names —
+    # BENCH rounds diff exactly these sort/segment legs on CPU and chip.
+    # unique_edges_sort/segment split unique_edges' packed sort from its
+    # unique-head selection; priority_sort is unique_priority's argsort
+    # leg; face_sort the packed face lexsort (same pass swap_face_pairs
+    # times below, under the sort engine's stable name); band_sort the
+    # incremental band's local sort.
+    from parmmg_tpu.core.mesh import tet_edge_vertices
+    from parmmg_tpu.ops import pallas_kernels as pk
+    from parmmg_tpu.ops.edges import sort_pairs, priority_order
+
+    def _edge_cols(m):
+        ev = tet_edge_vertices(m.tet).reshape(m.capT * 6, 2)
+        return (jnp.minimum(ev[:, 0], ev[:, 1]),
+                jnp.maximum(ev[:, 0], ev[:, 1]),
+                jnp.repeat(m.tmask, 6))
+    a6, b6, v6 = jax.jit(_edge_cols)(mesh)
+    capP = mesh.capP
+    timeit("unique_edges_sort",
+           lambda a, b, v: sort_pairs(a, b, v, capP)[0], a6, b6, v6)
+    ks6 = jax.jit(lambda a, b, v: jnp.sort(jnp.where(
+        v, a * capP + b, jnp.iinfo(jnp.int32).max)))(a6, b6, v6)
+    timeit("unique_edges_segment",
+           lambda k: pk.segment_first((k,)), ks6)
+    neg = jax.jit(lambda le, em: jnp.where(em, -le, jnp.inf))(
+        lens, et.emask)
+    timeit("priority_sort", priority_order, neg)
+    timeit("face_sort", adj.face_sort, mesh)
     timeit("split_wave", lambda m, k: split_wave(m, k), mesh, met)
     timeit("build_adjacency", adj.build_adjacency, mesh)
     timeit("collapse_wave", lambda m, k: collapse_wave(m, k), mesh, met)
@@ -154,7 +182,10 @@ def main():
                             fdirty=jnp.asarray(dirty))
     dt = jnp.asarray(np.concatenate(
         [live, np.full(bw - len(live), mesh.capT)]).astype(np.int32))
-    timeit("band_extract", edge_band_records, mesh, dt)
+    from parmmg_tpu.ops.topo_incr import band_order
+    bkey6, bslot6 = timeit("band_extract", edge_band_records, mesh, dt)
+    timeit("band_sort",
+           lambda bk, bs: band_order((bk,), bs), bkey6, bslot6)
     timeit("band_merge",
            lambda m, t: incr_unique_edges(m, t, on), mesh, topo_d)
     timeit("band_adjacency",

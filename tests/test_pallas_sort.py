@@ -1,0 +1,217 @@
+"""Parity of the Pallas radix-sort/segment engine vs jnp argsort/lexsort.
+
+Runs the kernels with ``interpret=True`` on the CPU test backend; on
+real TPU the production dispatch (ops/edges.py / ops/adjacency.py /
+ops/topo_incr.py through ``pallas_kernels.sort_perm`` under
+PARMMG_PALLAS_SORT) routes through the compiled versions of exactly
+these kernels.  Everything here asserts BIT equality — the sort engine's
+contract is "stable LSD radix == stable comparator sort", not "close".
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from parmmg_tpu.ops import pallas_kernels as pk
+
+I32_MAX = 2147483647
+# deliberately awkward lengths: 1, sub-lane, lane-1/lane/lane+1, odd,
+# crossing the (8,128) block boundary, multi-block prime
+SIZES = (1, 2, 127, 128, 129, 777, 1025, 4099)
+
+
+@pytest.fixture(scope="module")
+def rng():
+    return np.random.default_rng(11)
+
+
+@pytest.mark.parametrize("n", SIZES)
+def test_radix_single_word_vs_argsort(rng, n):
+    # duplicate-heavy keys: ties everywhere, stability is load-bearing
+    k = jnp.asarray(rng.integers(0, max(2, n // 8), n), jnp.int32)
+    ref = jnp.argsort(k)
+    got = pk.radix_sort_pallas((k,), interpret=True)
+    assert np.array_equal(np.asarray(ref), np.asarray(got))
+
+
+@pytest.mark.parametrize("n", SIZES)
+def test_radix_two_word_vs_lexsort(rng, n):
+    a = jnp.asarray(rng.integers(0, 7, n), jnp.int32)
+    b = jnp.asarray(rng.integers(0, 5, n), jnp.int32)
+    ref = jnp.lexsort((b, a))
+    got = pk.radix_sort_pallas((a, b), interpret=True)
+    assert np.array_equal(np.asarray(ref), np.asarray(got))
+
+
+def test_radix_three_word_vs_lexsort(rng):
+    n = 999
+    cols = [jnp.asarray(rng.integers(0, 4, n), jnp.int32)
+            for _ in range(3)]
+    ref = jnp.lexsort((cols[2], cols[1], cols[0]))
+    got = pk.radix_sort_pallas(tuple(cols), interpret=True)
+    assert np.array_equal(np.asarray(ref), np.asarray(got))
+
+
+@pytest.mark.parametrize("n", (130, 1025))
+def test_radix_int32max_tombstones(rng, n):
+    # the sites key dead slots INT32_MAX: must sort last, stably
+    k = jnp.asarray(rng.integers(0, 9, n), jnp.int32)
+    k = jnp.where(jnp.asarray(rng.random(n) < 0.4), jnp.int32(I32_MAX), k)
+    ref = jnp.argsort(k)
+    got = pk.radix_sort_pallas((k,), interpret=True)
+    assert np.array_equal(np.asarray(ref), np.asarray(got))
+
+
+def test_radix_all_equal_keys():
+    # all-equal: stability means the identity permutation
+    n = 515
+    e = jnp.zeros(n, jnp.int32)
+    got = pk.radix_sort_pallas((e,), interpret=True)
+    assert np.array_equal(np.asarray(got), np.arange(n))
+
+
+def test_radix_nbits16_tombstone_remap(rng):
+    # the face-sort shape: major word < capP <= 46340 < 2^16 with
+    # INT32_MAX tombstones, declared nbits=16 (2 digit passes) — the
+    # in-kernel remap to 0xFFFF must preserve the order exactly
+    n = 1337
+    s = jnp.asarray(rng.integers(0, 46340, n), jnp.int32)
+    s = jnp.where(jnp.asarray(rng.random(n) < 0.3), jnp.int32(I32_MAX), s)
+    w = jnp.asarray(rng.integers(0, 1 << 20, n), jnp.int32)
+    ref = jnp.lexsort((w, s))
+    got = pk.radix_sort_pallas((s, w), nbits=(16, 32), interpret=True)
+    assert np.array_equal(np.asarray(ref), np.asarray(got))
+
+
+def test_f32_sort_u32_matches_jax_total_order(rng):
+    # the uint32 image must mirror jax's stable comparator sort exactly:
+    # -0.0 == +0.0 (tie by position), all NaNs equal and after +inf
+    n = 521
+    x = rng.normal(size=n).astype(np.float32)
+    x[rng.random(n) < 0.15] = 0.0
+    x[rng.random(n) < 0.15] = -0.0
+    x[rng.random(n) < 0.1] = np.inf
+    x[rng.random(n) < 0.1] = -np.inf
+    x[rng.random(n) < 0.1] = np.nan
+    xs = jnp.asarray(x)
+    u = pk.f32_sort_u32(xs).astype(jnp.int32)
+    ref = jnp.argsort(xs)
+    got = pk.radix_sort_pallas((u,), interpret=True)
+    assert np.array_equal(np.asarray(ref), np.asarray(got))
+
+
+@pytest.mark.parametrize("n", SIZES)
+def test_segment_flags_single_word(rng, n):
+    k = jnp.sort(jnp.asarray(rng.integers(0, max(2, n // 4), n),
+                             jnp.int32))
+    ref = np.concatenate([[True], np.asarray(k[1:] != k[:-1])])
+    got = np.asarray(pk.segment_flags_pallas((k,), interpret=True))
+    assert np.array_equal(ref, got)
+
+
+def test_segment_flags_multi_word(rng):
+    n = 2051                       # crosses the 1024-element block seam
+    a = jnp.asarray(rng.integers(0, 6, n), jnp.int32)
+    b = jnp.asarray(rng.integers(0, 6, n), jnp.int32)
+    o = jnp.lexsort((b, a))
+    aa, bb = a[o], b[o]
+    ref = np.concatenate(
+        [[True], np.asarray((aa[1:] != aa[:-1]) | (bb[1:] != bb[:-1]))])
+    got = np.asarray(pk.segment_flags_pallas((aa, bb), interpret=True))
+    assert np.array_equal(ref, got)
+
+
+def test_segment_flags_all_equal():
+    n = 1100
+    e = jnp.full(n, 3, jnp.int32)
+    got = np.asarray(pk.segment_flags_pallas((e,), interpret=True))
+    ref = np.zeros(n, bool)
+    ref[0] = True
+    assert np.array_equal(ref, got)
+
+
+# ---- forced-interpret site-level dispatch parity ---------------------------
+
+def _forced(monkeypatch, on: bool):
+    if on:
+        monkeypatch.setenv("PARMMG_TPU_PALLAS", "1")
+        monkeypatch.setenv("PARMMG_PALLAS_SORT", "1")
+    else:
+        monkeypatch.delenv("PARMMG_TPU_PALLAS", raising=False)
+        monkeypatch.setenv("PARMMG_PALLAS_SORT", "0")
+
+
+def test_sort_pairs_forced_parity(rng, monkeypatch):
+    from parmmg_tpu.ops.edges import PACK_LIMIT, sort_pairs
+    n = 700
+    a = jnp.asarray(rng.integers(0, 40, n), jnp.int32)
+    b = jnp.asarray(rng.integers(0, 40, n), jnp.int32)
+    valid = jnp.asarray(rng.random(n) < 0.8)
+    outs = []
+    for on in (False, True):
+        _forced(monkeypatch, on)
+        # packed branch AND the unpacked 2-column fallback
+        outs.append([np.asarray(x)
+                     for cap in (40, PACK_LIMIT + 1)
+                     for x in sort_pairs(a, b, valid, cap)])
+    for x, y in zip(*outs):
+        assert np.array_equal(x, y)
+
+
+def test_unique_priority_forced_parity(rng, monkeypatch):
+    from parmmg_tpu.ops.edges import unique_priority
+    n = 600
+    # heavy score ties: the argsort-rank tie-break must survive
+    score = jnp.asarray(np.round(rng.random(n) * 8) / 8, jnp.float32)
+    mask = jnp.asarray(rng.random(n) < 0.7)
+    outs = []
+    for on in (False, True):
+        _forced(monkeypatch, on)
+        outs.append(np.asarray(unique_priority(score, mask)))
+    assert np.array_equal(outs[0], outs[1])
+
+
+def test_face_sort_forced_parity(monkeypatch):
+    from parmmg_tpu.core.mesh import make_mesh
+    from parmmg_tpu.ops import adjacency as adj
+    from parmmg_tpu.ops.analysis import analyze_mesh
+    from parmmg_tpu.utils.fixtures import cube_mesh
+    vert, tet = cube_mesh(2)
+    m = make_mesh(vert, tet, capP=4 * len(vert), capT=4 * len(tet))
+    m = analyze_mesh(m).mesh
+    outs = []
+    for on in (False, True):
+        _forced(monkeypatch, on)
+        outs.append([np.asarray(x) for x in adj.face_sort(m)])
+    for x, y in zip(*outs):
+        assert np.array_equal(x, y)
+
+
+def test_band_order_forced_parity(rng, monkeypatch):
+    from parmmg_tpu.ops.topo_incr import band_order
+    m = 300
+    bk = jnp.asarray(rng.integers(0, 50, m), jnp.int32)
+    bk = jnp.where(jnp.asarray(rng.random(m) < 0.3),
+                   jnp.int32(I32_MAX), bk)
+    bs = jnp.asarray(rng.permutation(m), jnp.int32)
+    outs = []
+    for on in (False, True):
+        _forced(monkeypatch, on)
+        outs.append(np.asarray(band_order((bk,), bs)))
+    assert np.array_equal(outs[0], outs[1])
+
+
+def test_pallas_sort_sites_static(monkeypatch):
+    # off-TPU without forcing, the dispatcher lowers only the reference
+    # and the bench site list is empty; forcing interpret mode lists
+    # every site
+    monkeypatch.setenv("PARMMG_PALLAS_SORT", "1")
+    monkeypatch.delenv("PARMMG_TPU_PALLAS", raising=False)
+    if jax.default_backend() != "tpu":
+        assert pk.pallas_sort_sites() == []
+    monkeypatch.setenv("PARMMG_TPU_PALLAS", "1")
+    assert set(pk.pallas_sort_sites()) == {
+        "unique_edges_sort", "unique_edges_segment", "priority_sort",
+        "face_sort", "band_sort"}
+    monkeypatch.setenv("PARMMG_PALLAS_SORT", "0")
+    assert pk.pallas_sort_sites() == []
